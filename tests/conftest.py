@@ -1,0 +1,17 @@
+"""Test harness config: force an 8-device virtual CPU mesh before JAX loads.
+
+The real benchmark path runs on the one attached TPU chip; tests validate
+multi-chip sharding on a virtual CPU mesh exactly the way the driver's
+``dryrun_multichip`` does (see ``__graft_entry__.py``).
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,257 @@
+"""Oracle engine tests.
+
+Mirrors the reference's doc tests (`list/doc.rs:513-677`): smoke,
+deletes_merged, the seeded randomized differential test against a plain
+string, and the local-vs-remote convergence test — plus the N-peer
+randomized concurrent merge test the reference lost
+(`.vscode/launch.json:11-12` mentions a vanished `random_concurrency`
+binary; SURVEY §4 calls for restoring it).
+"""
+import random
+
+import pytest
+
+from text_crdt_rust_tpu import (
+    LocalOp,
+    ROOT_REMOTE_ID,
+    RemoteDel,
+    RemoteId,
+    RemoteIns,
+    RemoteTxn,
+)
+from text_crdt_rust_tpu.models.oracle import ListCRDT
+from text_crdt_rust_tpu.models.sync import (
+    export_txns_since,
+    merge_into,
+    remote_frontier,
+)
+
+ALPHABET = "abcdefghijklmnop_"
+
+
+def random_str(rng: random.Random, length: int) -> str:
+    return "".join(rng.choice(ALPHABET) for _ in range(length))
+
+
+def make_random_change(doc: ListCRDT, text: str, agent: int,
+                       rng: random.Random) -> str:
+    """(`doc.rs:544-569` analog, string instead of rope as the oracle)"""
+    doc_len = len(doc)
+    insert_weight = 0.55 if doc_len < 100 else 0.45
+    if doc_len == 0 or rng.random() < insert_weight:
+        pos = rng.randint(0, doc_len)
+        content = random_str(rng, rng.randint(1, 3))
+        text = text[:pos] + content + text[pos:]
+        doc.local_insert(agent, pos, content)
+    else:
+        pos = rng.randint(0, doc_len - 1)
+        span = rng.randint(1, min(10, doc_len - pos))
+        text = text[:pos] + text[pos + span:]
+        doc.local_delete(agent, pos, span)
+    return text
+
+
+def test_smoke():
+    # (`doc.rs:522-532`)
+    doc = ListCRDT()
+    doc.get_or_create_agent_id("seph")
+    doc.local_insert(0, 0, "hi")
+    doc.local_insert(0, 1, "yooo")
+    doc.local_delete(0, 0, 3)
+    # "hi" → "hyoooi" → delete "hyo" → "ooi"
+    assert doc.to_string() == "ooi"
+    assert len(doc) == 3
+    doc.check()
+
+
+def test_deletes_merged():
+    # (`doc.rs:589-601`)
+    doc = ListCRDT()
+    doc.get_or_create_agent_id("seph")
+    doc.local_insert(0, 0, "abc")
+    doc.local_delete(0, 0, 1)
+    doc.local_delete(0, 0, 1)
+    doc.local_delete(0, 0, 1)
+    assert doc.to_string() == ""
+    # Three separate delete txns, targets 0,1,2 with op orders 3,4,5:
+    # the deletes log RLE-merges them into one entry.
+    assert doc.deletes.num_entries() == 1
+    e = doc.deletes.entries[0]
+    assert (e.op_order, e.target, e.length) == (3, 0, 3)
+    doc.check()
+
+
+def test_multi_op_txn():
+    doc = ListCRDT()
+    doc.get_or_create_agent_id("seph")
+    doc.local_insert(0, 0, "aaaa")
+    # One txn: delete 2 at pos 1, insert "xy" at pos 1.
+    doc.apply_local_txn(0, [LocalOp(pos=1, ins_content="xy", del_span=2)])
+    assert doc.to_string() == "axya"
+    assert doc.txns.num_entries() <= 2
+    doc.check()
+
+
+def test_random_single_document():
+    # (`doc.rs:571-587`)
+    rng = random.Random(7)
+    doc = ListCRDT()
+    agent = doc.get_or_create_agent_id("seph")
+    text = ""
+    for _ in range(1000):
+        text = make_random_change(doc, text, agent, rng)
+        assert doc.to_string() == text
+        assert len(doc) == len(text)
+    # Single-agent linear history compacts to single RLE entries
+    # (`doc.rs:585-586`).
+    assert doc.client_data[0].item_orders.num_entries() == 1
+    assert doc.client_with_order.num_entries() == 1
+    doc.check()
+
+
+def root_id():
+    return ROOT_REMOTE_ID
+
+
+def test_remote_txns_convergence():
+    # (`doc.rs:620-676`)
+    doc_remote = ListCRDT()
+    doc_remote.apply_remote_txn(RemoteTxn(
+        id=RemoteId("seph", 0),
+        parents=[root_id()],
+        ops=[RemoteIns(origin_left=root_id(), origin_right=root_id(),
+                       ins_content="hi")],
+    ))
+    assert doc_remote.to_string() == "hi"
+
+    doc_local = ListCRDT()
+    doc_local.get_or_create_agent_id("seph")
+    doc_local.local_insert(0, 0, "hi")
+
+    assert doc_remote.frontier == doc_local.frontier
+    assert doc_remote.txns == doc_local.txns
+    assert doc_remote.to_string() == doc_local.to_string()
+    assert doc_remote.deletes == doc_local.deletes
+
+    doc_remote.apply_remote_txn(RemoteTxn(
+        id=RemoteId("seph", 2),
+        parents=[RemoteId("seph", 1)],
+        ops=[RemoteDel(id=RemoteId("seph", 0), len=2)],
+    ))
+    doc_local.local_delete(0, 0, 2)
+
+    assert doc_remote.frontier == doc_local.frontier
+    assert doc_remote.txns == doc_local.txns
+    assert doc_remote.to_string() == doc_local.to_string()
+    assert doc_remote.deletes == doc_local.deletes
+    doc_remote.check()
+
+
+def test_concurrent_inserts_name_tiebreak():
+    """Two peers insert at the same spot concurrently: Yjs tiebreak orders
+    by agent *name* (`doc.rs:204-217`), and both peers converge."""
+    a = ListCRDT()
+    a.get_or_create_agent_id("alice")
+    a.local_insert(0, 0, "AA")
+
+    b = ListCRDT()
+    b.get_or_create_agent_id("bob")
+    b.local_insert(0, 0, "BB")
+
+    merge_into(a, b)
+    merge_into(b, a)
+    assert a.to_string() == b.to_string()
+    # Name order: "alice" < "bob" → alice's run first.
+    assert a.to_string() == "AABB"
+    assert remote_frontier(a) == remote_frontier(b)
+
+
+def test_double_delete_convergence():
+    """Both peers delete the same char concurrently — idempotent via the
+    double-deletes log (`double_delete.rs:6-9`)."""
+    a = ListCRDT()
+    a.get_or_create_agent_id("alice")
+    a.local_insert(0, 0, "xyz")
+    b = ListCRDT()
+    merge_into(b, a)
+    assert b.to_string() == "xyz"
+
+    a.local_delete(0, 1, 1)
+    b_agent = b.get_or_create_agent_id("bob")
+    b.local_delete(b_agent, 1, 1)
+
+    merge_into(a, b)
+    merge_into(b, a)
+    assert a.to_string() == b.to_string() == "xz"
+    assert a.double_deletes.num_entries() == 1
+    assert b.double_deletes.num_entries() == 1
+    assert a.double_deletes.entries[0].excess == 1
+
+
+def test_export_roundtrip_mixed_ops():
+    src = ListCRDT()
+    src.get_or_create_agent_id("seph")
+    src.local_insert(0, 0, "hello world")
+    src.local_delete(0, 2, 3)
+    src.apply_local_txn(0, [LocalOp(pos=4, ins_content="XY", del_span=2)])
+
+    dst = ListCRDT()
+    n = merge_into(dst, src)
+    assert n == len(export_txns_since(src, 0))
+    assert dst.to_string() == src.to_string()
+    assert dst.deletes == src.deletes
+    assert remote_frontier(dst) == remote_frontier(src)
+
+
+def test_incremental_sync_splits_partial_spans():
+    src = ListCRDT()
+    src.get_or_create_agent_id("seph")
+    src.local_insert(0, 0, "abc")
+    dst = ListCRDT()
+    merge_into(dst, src)
+    # src types more (linear history merges into the same txn span).
+    src.local_insert(0, 3, "def")
+    src.local_insert(0, 0, "!")
+    merge_into(dst, src)
+    assert dst.to_string() == src.to_string() == "!abcdef"
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_random_concurrency_n_peers(seed):
+    """The reference's missing `random_concurrency` test (SURVEY §4): N peers
+    make seeded random edits, sync pairwise at random, and must converge."""
+    rng = random.Random(1000 + seed)
+    names = ["alice", "bob", "carol"]
+    peers = []
+    texts = []
+    for name in names:
+        d = ListCRDT()
+        d.get_or_create_agent_id(name)
+        peers.append(d)
+        texts.append("")
+
+    for _round in range(12):
+        for i, d in enumerate(peers):
+            for _ in range(rng.randint(1, 4)):
+                texts[i] = make_random_change(d, texts[i], 0, rng)
+                assert d.to_string() == texts[i]
+        # Random pairwise sync.
+        i, j = rng.sample(range(len(peers)), 2)
+        merge_into(peers[i], peers[j])
+        merge_into(peers[j], peers[i])
+        texts[i] = peers[i].to_string()
+        texts[j] = peers[j].to_string()
+        assert texts[i] == texts[j]
+        for d in peers:
+            d.check()
+
+    # Full mesh sync to convergence.
+    for _ in range(2):
+        for i in range(len(peers)):
+            for j in range(len(peers)):
+                if i != j:
+                    merge_into(peers[i], peers[j])
+    final = peers[0].to_string()
+    for d in peers[1:]:
+        assert d.to_string() == final
+    assert len({frozenset(remote_frontier(d)) for d in peers}) == 1

@@ -1,0 +1,126 @@
+"""Tests for the RLE span algebra + flat containers.
+
+Mirrors the reference's inline tests: `rle/simple_rle.rs:113-155`,
+`list/double_delete.rs:109-139`, `list/txn.rs:62-92`, plus the
+SplitableSpan invariant (`splitable_span.rs:10-16`) property-checked over
+every span type.
+"""
+import copy
+
+import pytest
+
+from text_crdt_rust_tpu.utils.rle import (
+    KCRDTSpan,
+    KDeleteEntry,
+    KDoubleDelete,
+    KOrderSpan,
+    Rle,
+    TxnSpan,
+    increment_delete_range,
+)
+
+
+SPAN_EXAMPLES = [
+    KOrderSpan(seq=10, order=100, length=8),
+    KCRDTSpan(order=100, agent=2, seq=10, length=8),
+    KDeleteEntry(op_order=50, target=7, length=8),
+    KDoubleDelete(target=40, length=8, excess=3),
+    TxnSpan(order=64, length=8, shadow=2, parents=[63]),
+]
+
+
+@pytest.mark.parametrize("span", SPAN_EXAMPLES, ids=lambda s: type(s).__name__)
+def test_splitable_span_invariant(span):
+    # initial_len == at + rest.len and can_append(rest) (`splitable_span.rs:10-16`)
+    for at in range(1, span.length):
+        s = copy.deepcopy(span)
+        initial_len = s.length
+        rest = s.truncate(at)
+        assert s.length == at
+        assert s.length + rest.length == initial_len
+        assert s.can_append(rest)
+        s.append(rest)
+        assert s.length == initial_len
+
+
+def test_rle_find_at_offset():
+    # (`simple_rle.rs:113-126` analog)
+    rle = Rle()
+    rle.append(KOrderSpan(seq=0, order=1000, length=2))
+    assert rle.find(0) == (rle.entries[0], 0)
+    assert rle.find(1) == (rle.entries[0], 1)
+    assert rle.find(2) is None
+    assert rle.get(1) == 1001
+
+
+def test_rle_append_merges():
+    rle = Rle()
+    rle.append(KOrderSpan(seq=0, order=1000, length=2))
+    rle.append(KOrderSpan(seq=2, order=1002, length=3))
+    assert rle.num_entries() == 1
+    assert rle.entries[0].length == 5
+    # Non-contiguous: no merge.
+    rle.append(KOrderSpan(seq=9, order=1009, length=1))
+    assert rle.num_entries() == 2
+    rle.check()
+
+
+def test_rle_insert_neighbour_merge():
+    # (`simple_rle.rs:128-155` analog)
+    rle = Rle()
+    rle.insert(KOrderSpan(seq=5, order=105, length=2))
+    rle.insert(KOrderSpan(seq=0, order=100, length=2))
+    assert rle.num_entries() == 2
+    # Fill the gap: all three merge.
+    rle.insert(KOrderSpan(seq=2, order=102, length=3))
+    assert rle.num_entries() == 1
+    assert rle.entries[0] == KOrderSpan(seq=0, order=100, length=7)
+
+
+def test_txn_appends():
+    # (`txn.rs:70-92`)
+    a = TxnSpan(order=1000, length=10, shadow=500, parents=[999])
+    b = TxnSpan(order=1010, length=5, shadow=500, parents=[1009])
+    assert a.can_append(b)
+    a.append(b)
+    assert a == TxnSpan(order=1000, length=15, shadow=500, parents=[999])
+
+
+def test_increment_delete_range_table():
+    # Faithful port of the reference table test (`double_delete.rs:113-139`).
+    dd = Rle()
+    increment_delete_range(dd, 5, 3)
+    assert dd.entries == [KDoubleDelete(5, 3, 1)]
+    increment_delete_range(dd, 5, 3)
+    assert dd.entries == [KDoubleDelete(5, 3, 2)]
+    increment_delete_range(dd, 4, 2)
+    assert dd.entries == [
+        KDoubleDelete(4, 1, 1),
+        KDoubleDelete(5, 1, 3),
+        KDoubleDelete(6, 2, 2),
+    ]
+    increment_delete_range(dd, 7, 3)
+    assert dd.entries == [
+        KDoubleDelete(4, 1, 1),
+        KDoubleDelete(5, 1, 3),
+        KDoubleDelete(6, 1, 2),
+        KDoubleDelete(7, 1, 3),
+        KDoubleDelete(8, 2, 1),
+    ]
+
+
+def test_increment_delete_range_gap_merge():
+    dd = Rle()
+    increment_delete_range(dd, 0, 2)
+    increment_delete_range(dd, 2, 2)  # adjacent, same excess: merges
+    assert dd.entries == [KDoubleDelete(0, 4, 1)]
+    increment_delete_range(dd, 10, 1)
+    assert dd.num_entries() == 2
+    # Spanning a gap and an existing entry.
+    increment_delete_range(dd, 8, 4)
+    assert dd.entries == [
+        KDoubleDelete(0, 4, 1),
+        KDoubleDelete(8, 2, 1),
+        KDoubleDelete(10, 1, 2),
+        KDoubleDelete(11, 1, 1),
+    ]

@@ -1,0 +1,57 @@
+"""Trace loader tests (`testdata/src/lib.rs:50-59` analog) + oracle trace
+replay with final-content assertion (the criterion benches' check,
+`benches/yjs.rs:46`)."""
+import pytest
+
+from text_crdt_rust_tpu.models.oracle import ListCRDT
+from text_crdt_rust_tpu.utils.testdata import load_testing_data, trace_path
+
+
+def test_load_sveltecomponent():
+    data = load_testing_data(trace_path("sveltecomponent"))
+    assert data.start_content == ""
+    assert len(data.txns) == 18_335
+    assert data.num_patches() == 19_749
+    assert len(data.end_content) == 18_451
+
+
+def test_load_automerge_paper_counts():
+    data = load_testing_data(trace_path("automerge-paper"))
+    assert len(data.txns) == 259_778
+    ins = sum(len(p.ins_content) for t in data.txns for p in t.patches)
+    dels = sum(p.del_len for t in data.txns for p in t.patches)
+    assert ins == 182_315
+    assert dels == 77_463
+    assert len(data.end_content) == 104_852
+
+
+@pytest.mark.slow
+def test_oracle_replays_sveltecomponent():
+    data = load_testing_data(trace_path("sveltecomponent"))
+    doc = ListCRDT(capacity=1 << 18)
+    agent = doc.get_or_create_agent_id("trace")
+    for txn in data.txns:
+        for p in txn.patches:
+            if p.del_len:
+                doc.local_delete(agent, p.pos, p.del_len)
+            if p.ins_content:
+                doc.local_insert(agent, p.pos, p.ins_content)
+    assert doc.to_string() == data.end_content
+    doc.check()
+
+
+def test_oracle_replays_automerge_paper_prefix():
+    data = load_testing_data(trace_path("automerge-paper"))
+    doc = ListCRDT(capacity=1 << 16)
+    agent = doc.get_or_create_agent_id("trace")
+    text = ""
+    for txn in data.txns[:4000]:
+        for p in txn.patches:
+            if p.del_len:
+                text = text[: p.pos] + text[p.pos + p.del_len:]
+                doc.local_delete(agent, p.pos, p.del_len)
+            if p.ins_content:
+                text = text[: p.pos] + p.ins_content + text[p.pos:]
+                doc.local_insert(agent, p.pos, p.ins_content)
+    assert doc.to_string() == text
+    doc.check()

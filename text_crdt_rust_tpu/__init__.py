@@ -1,0 +1,43 @@
+"""text_crdt_rust_tpu — a TPU-native list/text CRDT framework.
+
+Brand-new rebuild of `josephg/text-crdt-rust` (see SURVEY.md): Yjs/YATA
+integration semantics over an automerge-style (agent, seq) data model.
+
+Layout (see each subpackage's __init__ for what is implemented):
+
+- ``models/``   document engines (Python oracle + sync layer; C++ native and
+                JAX/TPU batched engines join them as they land);
+- ``utils/``    RLE span algebra + flat containers (the host↔device wire
+                format), trace loader;
+- ``ops/``, ``parallel/``, ``native/``  device kernels, mesh sharding and
+                C++ sources respectively.
+"""
+
+from .common import (
+    CLIENT_INVALID,
+    CRDT_DOC_ROOT,
+    CRDTLocation,
+    LocalOp,
+    ROOT_ORDER,
+    ROOT_REMOTE_ID,
+    RemoteDel,
+    RemoteId,
+    RemoteIns,
+    RemoteTxn,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "CLIENT_INVALID",
+    "CRDT_DOC_ROOT",
+    "CRDTLocation",
+    "LocalOp",
+    "ROOT_ORDER",
+    "ROOT_REMOTE_ID",
+    "RemoteDel",
+    "RemoteId",
+    "RemoteIns",
+    "RemoteTxn",
+    "__version__",
+]

@@ -1,0 +1,3 @@
+from .oracle import ClientData, ListCRDT
+
+__all__ = ["ClientData", "ListCRDT"]

@@ -1,0 +1,193 @@
+"""Peer synchronization: export local history as ``RemoteTxn``s and merge.
+
+The reference defines the peer-portable structs (`external_txn.rs:5-30`) but
+implements no serializer or sync ("wire encoding is out of scope",
+SURVEY §2 L4). This module completes the layer: any engine exposing the
+oracle's log surface (client_with_order / item_orders / deletes / txns /
+per-item origins) can export its history since an order watermark and merge
+another peer's history, skipping already-known (agent, seq) ranges and
+splitting partially-known spans.
+
+All ids cross this boundary as (agent-name string, seq) pairs because
+numeric agent ids and orders are peer-local (`README.md:33-35`,
+`doc.rs:236-240`).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Set, Tuple
+
+from ..common import (
+    ROOT_ORDER,
+    RemoteDel,
+    RemoteId,
+    RemoteIns,
+    RemoteTxn,
+)
+from .oracle import ListCRDT
+
+
+def _try_raw_index(doc: ListCRDT, order: int) -> Optional[int]:
+    import numpy as np
+
+    hits = np.nonzero(doc.order[: doc.n] == np.uint32(order))[0]
+    return int(hits[0]) if hits.size else None
+
+
+def export_txns_since(doc: ListCRDT, start_order: int = 0) -> List[RemoteTxn]:
+    """All history with order >= ``start_order`` as RemoteTxns, in order.
+
+    Txn spans are split on agent boundaries (the txns RLE can merge linear
+    history across agents, `txn.rs:38-42`) and re-derive per-run ops from the
+    logs: a delete-op order range is found in the deletes log
+    (`list/mod.rs:82-84`); anything else is an insert run whose implicit
+    origin chain (`span.rs:9-18`) bounds the run.
+    """
+    out: List[RemoteTxn] = []
+    end_order = doc.get_next_order()
+    o = start_order
+    while o < end_order:
+        txn_found = doc.txns.find(o)
+        assert txn_found is not None, f"no txn covering order {o}"
+        txn_entry, txn_off = txn_found
+        txn_end = txn_entry.order + txn_entry.length
+        # Split on agent span boundaries too.
+        cwo_found = doc.client_with_order.find(o)
+        assert cwo_found is not None
+        cwo_entry, cwo_off = cwo_found
+        cwo_end = cwo_entry.order + cwo_entry.length
+        sub_end = min(txn_end, cwo_end)
+
+        agent_name = doc.get_agent_name(cwo_entry.agent)
+        seq0 = cwo_entry.seq + cwo_off
+        if txn_off == 0:
+            parents = [doc.order_to_remote_id(p) for p in txn_entry.parents]
+        else:
+            # Interior of a merged linear span: parent is the previous op.
+            parents = [doc.order_to_remote_id(o - 1)]
+
+        ops: List = []
+        pos = o
+        while pos < sub_end:
+            del_found = doc.deletes.find(pos)
+            if del_found is not None:
+                de, de_off = del_found
+                take = min(de.op_order + de.length, sub_end) - pos
+                # Split the target run at our client_with_order span
+                # boundaries: a (agent, seq) range is only portable as one
+                # RemoteDel if it was assigned orders as one run (the
+                # reference's implicit "contiguous from a single client"
+                # constraint, `list/mod.rs` OpExternal comment).
+                t0 = de.target + de_off
+                t_found = doc.client_with_order.find(t0)
+                assert t_found is not None
+                t_entry, t_off = t_found
+                take = min(take, t_entry.length - t_off)
+                ops.append(RemoteDel(
+                    id=doc.order_to_remote_id(t0),
+                    len=take,
+                ))
+                pos += take
+            else:
+                # Insert run: orders pos.. while the implicit origin chain
+                # holds and items exist in the body.
+                i0 = _try_raw_index(doc, pos)
+                assert i0 is not None, f"order {pos} neither delete nor insert"
+                origin_left = int(doc.origin_left[i0])
+                origin_right = int(doc.origin_right[i0])
+                run_idx = [i0]
+                p = pos + 1
+                while p < sub_end:
+                    ii = _try_raw_index(doc, p)
+                    if ii is None:
+                        break
+                    if int(doc.origin_left[ii]) != p - 1:
+                        break
+                    if int(doc.origin_right[ii]) != origin_right:
+                        break
+                    run_idx.append(ii)
+                    p += 1
+                chars = [chr(int(doc.chars[iq])) for iq in run_idx]
+                ops.append(RemoteIns(
+                    origin_left=doc.order_to_remote_id(origin_left),
+                    origin_right=doc.order_to_remote_id(origin_right),
+                    ins_content="".join(chars),
+                ))
+                pos = p
+
+        out.append(RemoteTxn(
+            id=RemoteId(agent_name, seq0),
+            parents=parents,
+            ops=ops,
+        ))
+        o = sub_end
+    return out
+
+
+def _split_txn_at(txn: RemoteTxn, at: int) -> RemoteTxn:
+    """Return the suffix of ``txn`` starting ``at`` ops in (0 < at < len).
+
+    Valid because within one exported txn, seqs and op offsets advance
+    together (`doc.rs:252-269`)."""
+    agent = txn.id.agent
+    consumed = 0
+    suffix_ops: List = []
+    for op in txn.ops:
+        if isinstance(op, RemoteIns):
+            ln = len(op.ins_content)
+        else:
+            ln = op.len
+        if consumed + ln <= at:
+            consumed += ln
+            continue
+        if consumed >= at:
+            suffix_ops.append(op)
+            consumed += ln
+            continue
+        # Split this op.
+        off = at - consumed
+        if isinstance(op, RemoteIns):
+            suffix_ops.append(RemoteIns(
+                # Implicit chain: predecessor is (agent, seq+at-1)
+                # (`span.rs:24-28`).
+                origin_left=RemoteId(agent, txn.id.seq + at - 1),
+                origin_right=op.origin_right,
+                ins_content=op.ins_content[off:],
+            ))
+        else:
+            suffix_ops.append(RemoteDel(
+                id=RemoteId(op.id.agent, op.id.seq + off),
+                len=op.len - off,
+            ))
+        consumed += ln
+    return RemoteTxn(
+        id=RemoteId(agent, txn.id.seq + at),
+        parents=[RemoteId(agent, txn.id.seq + at - 1)],
+        ops=suffix_ops,
+    )
+
+
+def merge_into(dst: ListCRDT, src: ListCRDT) -> int:
+    """Apply everything ``dst`` is missing from ``src``'s history.
+
+    Returns the number of RemoteTxns applied. Applying in source order is
+    causally safe: parents always have smaller source order than their txn.
+    """
+    applied = 0
+    for txn in export_txns_since(src, 0):
+        agent = dst.get_or_create_agent_id(txn.id.agent)
+        next_seq = dst.client_data[agent].get_next_seq()
+        txn_len = 0
+        for op in txn.ops:
+            txn_len += len(op.ins_content) if isinstance(op, RemoteIns) else op.len
+        if txn.id.seq + txn_len <= next_seq:
+            continue  # fully known
+        if txn.id.seq < next_seq:
+            txn = _split_txn_at(txn, next_seq - txn.id.seq)
+        dst.apply_remote_txn(txn)
+        applied += 1
+    return applied
+
+
+def remote_frontier(doc: ListCRDT) -> Set[RemoteId]:
+    """Frontier as peer-portable ids (orders are peer-local)."""
+    return {doc.order_to_remote_id(o) for o in doc.frontier}

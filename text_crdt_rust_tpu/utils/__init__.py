@@ -1,0 +1,25 @@
+from .rle import (
+    KCRDTSpan,
+    KDeleteEntry,
+    KDoubleDelete,
+    KOrderSpan,
+    Rle,
+    TxnSpan,
+    increment_delete_range,
+)
+from .testdata import TestData, TestPatch, TestTxn, load_testing_data, trace_path
+
+__all__ = [
+    "KCRDTSpan",
+    "KDeleteEntry",
+    "KDoubleDelete",
+    "KOrderSpan",
+    "Rle",
+    "TxnSpan",
+    "increment_delete_range",
+    "TestData",
+    "TestPatch",
+    "TestTxn",
+    "load_testing_data",
+    "trace_path",
+]
