@@ -472,27 +472,13 @@ class ListCRDT:
     def doc_spans(self) -> List[Tuple[int, int, int, int]]:
         """Document body as maximally RLE-merged YjsSpan tuples
         (order, origin_left, origin_right, signed_len) — the canonical
-        compacted form used to compare engines (merge predicate
-        `span.rs:47-53`)."""
-        out: List[Tuple[int, int, int, int]] = []
-        for i in range(self.n):
-            o = int(self.order[i])
-            ol = int(self.origin_left[i])
-            orr = int(self.origin_right[i])
-            sgn = -1 if self.deleted[i] else 1
-            if out:
-                po, pol, porr, plen = out[-1]
-                alen = abs(plen)
-                if (
-                    (plen > 0) == (sgn > 0)
-                    and o == po + alen
-                    and ol == o - 1
-                    and orr == porr
-                ):
-                    out[-1] = (po, pol, porr, plen + sgn)
-                    continue
-            out.append((o, ol, orr, sgn))
-        return out
+        compacted form used to compare engines."""
+        from ..utils.rle import merge_yjs_spans
+        return merge_yjs_spans(
+            (int(self.order[i]), int(self.origin_left[i]),
+             int(self.origin_right[i]), -1 if self.deleted[i] else 1)
+            for i in range(self.n)
+        )
 
     def position_of_order(self, order: int) -> int:
         """Content position of a live item (inverse lookup, `cursor.rs:147-190`)."""

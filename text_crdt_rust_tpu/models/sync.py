@@ -26,13 +26,6 @@ from ..common import (
 from .oracle import ListCRDT
 
 
-def _try_raw_index(doc: ListCRDT, order: int) -> Optional[int]:
-    import numpy as np
-
-    hits = np.nonzero(doc.order[: doc.n] == np.uint32(order))[0]
-    return int(hits[0]) if hits.size else None
-
-
 def export_txns_since(doc: ListCRDT, start_order: int = 0) -> List[RemoteTxn]:
     """All history with order >= ``start_order`` as RemoteTxns, in order.
 
@@ -44,6 +37,8 @@ def export_txns_since(doc: ListCRDT, start_order: int = 0) -> List[RemoteTxn]:
     """
     out: List[RemoteTxn] = []
     end_order = doc.get_next_order()
+    # One pass over the body: order -> raw index (avoids a per-char scan).
+    idx_of = {int(doc.order[i]): i for i in range(doc.n)}
     o = start_order
     while o < end_order:
         txn_found = doc.txns.find(o)
@@ -90,14 +85,14 @@ def export_txns_since(doc: ListCRDT, start_order: int = 0) -> List[RemoteTxn]:
             else:
                 # Insert run: orders pos.. while the implicit origin chain
                 # holds and items exist in the body.
-                i0 = _try_raw_index(doc, pos)
+                i0 = idx_of.get(pos)
                 assert i0 is not None, f"order {pos} neither delete nor insert"
                 origin_left = int(doc.origin_left[i0])
                 origin_right = int(doc.origin_right[i0])
                 run_idx = [i0]
                 p = pos + 1
                 while p < sub_end:
-                    ii = _try_raw_index(doc, p)
+                    ii = idx_of.get(p)
                     if ii is None:
                         break
                     if int(doc.origin_left[ii]) != p - 1:

@@ -1,0 +1,46 @@
+"""Build the native engine shared library with g++ (no pip deps).
+
+Usage: ``python -m text_crdt_rust_tpu.native.build`` or just import
+``text_crdt_rust_tpu.models.native`` (builds on demand, cached by source
+hash).
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(HERE, "tcr_engine.cpp")
+BUILD_DIR = os.path.join(HERE, "_build")
+
+
+def _src_hash() -> str:
+    with open(SRC, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()[:16]
+
+
+def lib_path() -> str:
+    return os.path.join(BUILD_DIR, f"libtcr_{_src_hash()}.so")
+
+
+def build(verbose: bool = False) -> str:
+    """Compile (if needed) and return the shared-library path."""
+    out = lib_path()
+    if os.path.exists(out):
+        return out
+    os.makedirs(BUILD_DIR, exist_ok=True)
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-fPIC", "-shared",
+        "-march=native", "-fno-exceptions", "-fno-rtti",
+        SRC, "-o", out,
+    ]
+    if verbose:
+        print(" ".join(cmd), file=sys.stderr)
+    subprocess.run(cmd, check=True)
+    return out
+
+
+if __name__ == "__main__":
+    print(build(verbose=True))

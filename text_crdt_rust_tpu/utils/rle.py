@@ -292,6 +292,26 @@ class Rle(Generic[E]):
             prev_end = e.key + e.length
 
 
+def merge_yjs_spans(spans):
+    """Canonicalize a doc-order sequence of YjsSpan tuples
+    (order, origin_left, origin_right, signed_len) by maximally RLE-merging
+    adjacent spans under the reference predicate (`span.rs:47-53`): same
+    sign, consecutive orders, chained origin_left, shared origin_right.
+    Every engine's doc_spans() reports this form so they compare exactly.
+    """
+    out = []
+    for (o, ol, orr, slen) in spans:
+        if out:
+            po, pol, porr, plen = out[-1]
+            alen = abs(plen)
+            if ((plen > 0) == (slen > 0) and o == po + alen
+                    and ol == o - 1 and orr == porr):
+                out[-1] = (po, pol, porr, plen + slen)
+                continue
+        out.append((o, ol, orr, slen))
+    return out
+
+
 def increment_delete_range(rle: Rle[KDoubleDelete], base: int, length: int) -> None:
     """Gap-aware interval-increment over the double-delete RLE vector.
 
