@@ -1,13 +1,19 @@
-"""Test harness config: force an 8-device virtual CPU mesh before JAX loads.
+"""Test harness config: force an 8-device virtual CPU mesh.
 
 The real benchmark path runs on the one attached TPU chip; tests validate
-multi-chip sharding on a virtual CPU mesh exactly the way the driver's
-``dryrun_multichip`` does (see ``__graft_entry__.py``).
+kernels and multi-chip sharding on a virtual CPU mesh exactly the way the
+driver's ``dryrun_multichip`` does (see ``__graft_entry__.py``).
+
+NOTE this environment pre-registers the TPU platform from sitecustomize at
+interpreter startup (so ``JAX_PLATFORMS`` env is already consumed by the
+time conftest runs); the supported override is
+``jax.config.update("jax_platforms", ...)``, plus ``XLA_FLAGS`` for the
+host-device count, which is read lazily when the CPU client is first
+created.
 """
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -15,3 +21,7 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -14,7 +14,7 @@ numeric agent ids and orders are peer-local (`README.md:33-35`,
 """
 from __future__ import annotations
 
-from typing import List, Optional, Set, Tuple
+from typing import List, Set
 
 from ..common import (
     ROOT_ORDER,

@@ -31,14 +31,18 @@ def build(verbose: bool = False) -> str:
     if os.path.exists(out):
         return out
     os.makedirs(BUILD_DIR, exist_ok=True)
+    # Compile to a temp path and rename into place so a concurrent builder
+    # can never dlopen a partially written library.
+    tmp = f"{out}.tmp.{os.getpid()}"
     cmd = [
         "g++", "-O3", "-std=c++17", "-fPIC", "-shared",
         "-march=native", "-fno-exceptions", "-fno-rtti",
-        SRC, "-o", out,
+        SRC, "-o", tmp,
     ]
     if verbose:
         print(" ".join(cmd), file=sys.stderr)
     subprocess.run(cmd, check=True)
+    os.rename(tmp, out)
     return out
 
 

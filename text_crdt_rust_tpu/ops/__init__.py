@@ -1,0 +1,20 @@
+"""JAX/XLA device kernels — the TPU-native document engines.
+
+Two engines share one semantic model (the flattened YjsSpan item layout,
+see ``span_arrays``):
+
+- ``flat``    — correctness-first engine: per-item arrays in document order,
+                every op is O(capacity) fully-vectorized work. Supports the
+                complete op surface (local edits, remote inserts with the
+                YATA integrate scan + name-rank tiebreak, remote deletes with
+                double-delete detection). The device twin of
+                ``models.oracle.ListCRDT``.
+- ``blocked`` — throughput engine for the north-star trace-replay path:
+                the document is a fixed grid of blocks; each op touches one
+                block plus an O(num_blocks) index, with periodic all-doc
+                rebalance passes replacing the reference B-tree's node splits
+                (`range_tree/mutations.rs:623-808`).
+
+``batch`` compiles editing traces into fixed-shape op tensors (the host-side
+analog of the reference's bench replay loop, `benches/yjs.rs:32-49`).
+"""

@@ -1,0 +1,370 @@
+"""Host-side op compiler: edit traces / RemoteTxn streams -> device op tensors.
+
+The reference replays edits through per-op O(log n) B-tree walks
+(`benches/yjs.rs:41-48` -> `doc.rs:376-469`). The TPU engines instead consume
+*pre-compiled, fixed-shape op tensors*: one row per device step, everything
+an op needs resolved to dense integers host-side:
+
+- agent names     -> name *ranks* (the Yjs tiebreak is on agent name,
+                     `doc.rs:206-209`, so the device compares ranks);
+- remote ids      -> orders (`doc.rs:236-240`, via per-agent seq->order RLE
+                     maps, `list/mod.rs:33-43`);
+- order allocation (`doc.rs:155-165`) — the compiler threads ``next_order``
+  through the stream and bakes each insert run's first order into its step;
+- remote delete targets are walked in *seq space* and split at the target
+  agent's item_orders run boundaries so each step's target range is
+  order-contiguous (the fragmentation loop of `doc.rs:311-334`);
+- insert runs longer than the static ``lmax`` are split into chained chunks —
+  chunk k's origin_left is the last item of chunk k-1, exactly the implicit
+  origin chain a split span keeps (`span.rs:24-28,33-45`).
+
+Time-DAG bookkeeping (frontier advance `doc.rs:34-48`, txn spans, causal
+order) stays host-side per SURVEY §7; the compiler only asserts txns arrive
+causally ready (see ``parallel.causal`` for the buffering layer).
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..common import (
+    CLIENT_INVALID,
+    ROOT_ORDER,
+    RemoteDel,
+    RemoteId,
+    RemoteIns,
+    RemoteTxn,
+)
+from ..utils.rle import KOrderSpan, Rle
+from ..utils.testdata import TestData, TestPatch, flatten_patches
+
+# Op kinds (device-side dispatch in ops.flat / ops.blocked).
+KIND_LOCAL = 0        # delete del_len live chars at pos, then insert at pos
+KIND_REMOTE_INS = 1   # YATA-integrate an insert run at resolved origins
+KIND_REMOTE_DEL = 2   # tombstone an order-contiguous target range
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=[
+        "kind", "pos", "del_len", "del_target", "origin_left", "origin_right",
+        "ins_len", "ins_order_start", "order_advance", "rank", "chars",
+    ],
+    meta_fields=[],
+)
+@dataclasses.dataclass
+class OpTensors:
+    """One device step per row; all u32. Batched streams stack a trailing
+    doc axis *after* the step axis (time-major for ``lax.scan``)."""
+
+    kind: jax.Array             # u32[S, ...]
+    pos: jax.Array              # u32[S, ...]   KIND_LOCAL: content position
+    del_len: jax.Array          # u32[S, ...]   local del span / remote target len
+    del_target: jax.Array       # u32[S, ...]   KIND_REMOTE_DEL: first target order
+    origin_left: jax.Array      # u32[S, ...]   KIND_REMOTE_INS
+    origin_right: jax.Array     # u32[S, ...]   KIND_REMOTE_INS
+    ins_len: jax.Array          # u32[S, ...]
+    ins_order_start: jax.Array  # u32[S, ...]   first order of the insert run
+    order_advance: jax.Array    # u32[S, ...]   orders consumed by this step
+    rank: jax.Array             # u32[S, ...]   author agent's name rank
+    chars: jax.Array            # u32[S, ..., LMAX]
+
+    @property
+    def num_steps(self) -> int:
+        return self.kind.shape[0]
+
+    @property
+    def lmax(self) -> int:
+        return self.chars.shape[-1]
+
+
+class AgentTable:
+    """Agent name <-> dense id + *name rank* table.
+
+    The device tiebreak compares ranks; ranks are the index of each name in
+    the sorted name list, so rank order == name order (`doc.rs:206-209`).
+    All agents in a compiled stream must be registered up front — adding a
+    name later would reshuffle ranks under compiled steps.
+    """
+
+    def __init__(self, names: Iterable[str] = ()):
+        self.names: List[str] = []
+        self._ids: Dict[str, int] = {}
+        for n in names:
+            self.add(n)
+
+    def add(self, name: str) -> int:
+        if name == "ROOT":
+            return CLIENT_INVALID
+        if name not in self._ids:
+            self._ids[name] = len(self.names)
+            self.names.append(name)
+        return self._ids[name]
+
+    def id_of(self, name: str) -> int:
+        if name == "ROOT":
+            return CLIENT_INVALID
+        return self._ids[name]
+
+    def rank_of_agent(self) -> np.ndarray:
+        """rank_of_agent[dense agent id] -> name rank (u32)."""
+        order = sorted(range(len(self.names)), key=lambda i: self.names[i])
+        ranks = np.zeros(len(self.names), dtype=np.uint32)
+        for r, i in enumerate(order):
+            ranks[i] = r
+        return ranks
+
+    def rank_of(self, name: str) -> int:
+        return int(self.rank_of_agent()[self.id_of(name)])
+
+
+class OrderAssigner:
+    """Host twin of the order-allocation metadata (`doc.rs:155-165`):
+    per-agent seq->order RLE maps (`list/mod.rs:33-43`) + the dense
+    ``next_order`` counter. Shared by the compiler and the causal layer."""
+
+    def __init__(self, table: AgentTable):
+        self.table = table
+        self.item_orders: List[Rle[KOrderSpan]] = [
+            Rle() for _ in table.names
+        ]
+        self.next_order = 0
+
+    def _orders_of(self, agent_id: int) -> Rle:
+        while agent_id >= len(self.item_orders):
+            self.item_orders.append(Rle())
+        return self.item_orders[agent_id]
+
+    def next_seq(self, agent_id: int) -> int:
+        io = self._orders_of(agent_id)
+        last = io.last()
+        return last.seq + last.length if last is not None else 0
+
+    def assign(self, agent_id: int, seq: int, length: int) -> int:
+        """Allocate ``length`` dense orders to (agent, seq..) and return the
+        first (`doc.rs:155-165`)."""
+        first = self.next_order
+        self._orders_of(agent_id).append(KOrderSpan(seq, first, length))
+        self.next_order += length
+        return first
+
+    def seq_to_order(self, agent_id: int, seq: int) -> int:
+        found = self._orders_of(agent_id).find(seq)
+        assert found is not None, f"unknown seq {seq} for agent {agent_id}"
+        entry, off = found
+        return entry.order + off
+
+    def resolve(self, rid: RemoteId) -> int:
+        if rid.agent == "ROOT":
+            return ROOT_ORDER
+        return self.seq_to_order(self.table.id_of(rid.agent), rid.seq)
+
+    def target_runs(self, agent_id: int, seq: int,
+                    length: int) -> List[Tuple[int, int]]:
+        """Split a (agent, seq, len) delete target into order-contiguous
+        (first_order, len) runs (the `doc.rs:311-334` fragmentation walk,
+        done in seq space like the oracle)."""
+        runs: List[Tuple[int, int]] = []
+        io = self._orders_of(agent_id)
+        remaining = length
+        while remaining > 0:
+            found = io.find(seq)
+            assert found is not None, f"delete target seq {seq} unknown"
+            entry, off = found
+            take = min(entry.length - off, remaining)
+            runs.append((entry.order + off, take))
+            seq += take
+            remaining -= take
+        return runs
+
+
+class _Rows:
+    """Column accumulator for compiled steps."""
+
+    def __init__(self, lmax: int):
+        self.lmax = lmax
+        self.cols: Dict[str, list] = {
+            f.name: [] for f in dataclasses.fields(OpTensors)
+        }
+
+    def emit(self, *, kind=0, pos=0, del_len=0, del_target=0,
+             origin_left=ROOT_ORDER, origin_right=ROOT_ORDER, ins_len=0,
+             ins_order_start=0, order_advance=0, rank=0,
+             content: str = "") -> None:
+        assert ins_len <= self.lmax
+        cps = np.zeros(self.lmax, dtype=np.uint32)
+        if content:
+            assert len(content) == ins_len
+            cps[:ins_len] = np.frombuffer(
+                content.encode("utf-32-le"), dtype=np.uint32)
+        c = self.cols
+        c["kind"].append(kind); c["pos"].append(pos)
+        c["del_len"].append(del_len); c["del_target"].append(del_target)
+        c["origin_left"].append(origin_left)
+        c["origin_right"].append(origin_right)
+        c["ins_len"].append(ins_len)
+        c["ins_order_start"].append(ins_order_start)
+        c["order_advance"].append(order_advance)
+        c["rank"].append(rank)
+        c["chars"].append(cps)
+
+    def to_tensors(self) -> OpTensors:
+        c = self.cols
+        return OpTensors(
+            **{k: np.asarray(v, dtype=np.uint32) for k, v in c.items()
+               if k != "chars"},
+            chars=(np.stack(c["chars"]) if c["chars"]
+                   else np.zeros((0, self.lmax), dtype=np.uint32)),
+        )
+
+
+def compile_local_patches(
+    patches: Sequence[TestPatch],
+    rank: int = 0,
+    lmax: int = 16,
+    start_order: int = 0,
+) -> Tuple[OpTensors, int]:
+    """Single-author local edit stream -> op tensors.
+
+    Returns ``(ops, next_order)``. Each patch deletes then inserts at
+    ``pos`` (`doc.rs:392-464` op order: delete ops take the earlier order
+    numbers, then the insert run).
+    """
+    rows = _Rows(lmax)
+    next_order = start_order
+    for p in patches:
+        ins = p.ins_content
+        # First step carries the whole delete (the live-rank window op
+        # handles any span in one pass) + the first insert chunk.
+        first_chunk = ins[:lmax]
+        rows.emit(
+            kind=KIND_LOCAL, pos=p.pos, del_len=p.del_len,
+            ins_len=len(first_chunk),
+            ins_order_start=next_order + p.del_len,
+            order_advance=p.del_len + len(first_chunk),
+            rank=rank, content=first_chunk,
+        )
+        next_order += p.del_len + len(first_chunk)
+        off = len(first_chunk)
+        while off < len(ins):
+            chunk = ins[off:off + lmax]
+            rows.emit(
+                kind=KIND_LOCAL, pos=p.pos + off, ins_len=len(chunk),
+                ins_order_start=next_order, order_advance=len(chunk),
+                rank=rank, content=chunk,
+            )
+            next_order += len(chunk)
+            off += len(chunk)
+    return rows.to_tensors(), next_order
+
+
+def compile_trace(data: TestData, rank: int = 0, lmax: int = 16
+                  ) -> Tuple[OpTensors, int]:
+    """Whole-trace convenience wrapper (the `benches/yjs.rs:32-49` replay)."""
+    return compile_local_patches(flatten_patches(data), rank=rank, lmax=lmax)
+
+
+def compile_remote_txns(
+    txns: Sequence[RemoteTxn],
+    table: AgentTable,
+    assigner: Optional[OrderAssigner] = None,
+    lmax: int = 16,
+) -> Tuple[OpTensors, OrderAssigner]:
+    """Causally-ordered RemoteTxn stream -> op tensors (`doc.rs:242-348`).
+
+    The ``assigner`` carries the peer-local order metadata between calls
+    (streaming apply); txns must arrive causally ready — buffering
+    out-of-order arrivals is ``parallel.causal``'s job.
+    """
+    if assigner is None:
+        assigner = OrderAssigner(table)
+    ranks = table.rank_of_agent()
+    rows = _Rows(lmax)
+    for txn in txns:
+        agent = table.id_of(txn.id.agent)
+        assert assigner.next_seq(agent) == txn.id.seq, (
+            f"remote txn out of order: expected seq "
+            f"{assigner.next_seq(agent)}, got {txn.id.seq} "
+            f"(buffer with parallel.causal.CausalBuffer)"
+        )
+        txn_len = sum(
+            len(op.ins_content) if isinstance(op, RemoteIns) else op.len
+            for op in txn.ops
+        )
+        assert txn_len > 0, "empty remote txn"
+        # Orders for the whole txn are allocated up front (`doc.rs:265-269`)
+        # so intra-txn origin references resolve.
+        cursor = assigner.assign(agent, txn.id.seq, txn_len)
+        for op in txn.ops:
+            if isinstance(op, RemoteIns):
+                ins = op.ins_content
+                if not ins:
+                    continue
+                origin_left = assigner.resolve(op.origin_left)
+                origin_right = assigner.resolve(op.origin_right)
+                off = 0
+                while off < len(ins):
+                    chunk = ins[off:off + lmax]
+                    rows.emit(
+                        kind=KIND_REMOTE_INS,
+                        origin_left=origin_left,
+                        origin_right=origin_right,
+                        ins_len=len(chunk), ins_order_start=cursor,
+                        order_advance=len(chunk),
+                        rank=int(ranks[agent]), content=chunk,
+                    )
+                    origin_left = cursor + len(chunk) - 1
+                    cursor += len(chunk)
+                    off += len(chunk)
+            else:
+                assert isinstance(op, RemoteDel)
+                target_agent = table.id_of(op.id.agent)
+                for first, length in assigner.target_runs(
+                        target_agent, op.id.seq, op.len):
+                    rows.emit(
+                        kind=KIND_REMOTE_DEL, del_target=first,
+                        del_len=length, order_advance=length,
+                        rank=int(ranks[agent]),
+                    )
+                    cursor += length
+    return rows.to_tensors(), assigner
+
+
+# -- batching ----------------------------------------------------------------
+
+
+def pad_ops(ops: OpTensors, num_steps: int) -> OpTensors:
+    """Pad a step stream with no-ops (KIND_LOCAL, all-zero lengths is an
+    exact no-op in both engines)."""
+    s = ops.num_steps
+    assert s <= num_steps
+    if s == num_steps:
+        return ops
+
+    def pad(a):
+        width = [(0, num_steps - s)] + [(0, 0)] * (a.ndim - 1)
+        return np.pad(np.asarray(a), width)
+
+    return jax.tree.map(pad, ops)
+
+
+def stack_ops(streams: Sequence[OpTensors]) -> OpTensors:
+    """Ragged per-doc streams -> one time-major [S, B, ...] tensor batch
+    (config 3's mixed-corpus batch; shorter docs run no-op tail steps)."""
+    s_max = max(o.num_steps for o in streams)
+    padded = [pad_ops(o, s_max) for o in streams]
+    return jax.tree.map(lambda *xs: np.stack(xs, axis=1), *padded)
+
+
+def tile_ops(ops: OpTensors, batch: int) -> OpTensors:
+    """One stream -> B identical docs (config 2: `random_edits` x 1k docs)."""
+    return jax.tree.map(
+        lambda a: np.broadcast_to(
+            np.asarray(a)[:, None, ...], (a.shape[0], batch) + a.shape[1:]
+        ),
+        ops,
+    )

@@ -1,0 +1,209 @@
+"""Flat device engine: every CRDT op as fully-vectorized array work.
+
+The device twin of ``models.oracle.ListCRDT`` — same flattened item layout,
+same semantics, jit/vmap/scan-compatible. Each step is O(capacity) of
+branch-free vector work (XLA-fusable), so this engine is the *correctness*
+engine and the remote/concurrent path; ``ops.blocked`` is the throughput
+engine for the trace-replay hot path.
+
+How the reference's per-op O(log n) machinery maps here (SURVEY §7):
+
+- B-tree descent `root.rs:54-88` -> ``cumsum`` over the live mask +
+  ``searchsorted`` (position -> row);
+- order -> leaf-ptr SpaceIndex `split_list/mod.rs:440` -> ``argmax`` over an
+  equality mask (order -> row);
+- cursor total order `cursor.rs:274-304` -> integer comparison of rows;
+- the YATA integrate scan `doc.rs:167-234` -> a ``lax.while_loop`` from the
+  origin cursor, with the name tiebreak on precompiled agent ranks and the
+  scanning/scan_start backtrack carried as loop state;
+- tombstoning `span.rs:110-119` -> boolean mask OR (local deletes select a
+  live-rank window; remote deletes select an order range, which also makes
+  the fragmented-target walk `doc.rs:311-334` a single mask op);
+- splice + node splits `mutations.rs:17-179,623-808` -> one gather with a
+  shifted index map (no splits: capacity is static).
+
+Frontier/time-DAG bookkeeping stays host-side (``models.oracle`` /
+``parallel.causal``), per SURVEY §7 "keep on host".
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..common import ROOT_ORDER
+from .batch import KIND_LOCAL, KIND_REMOTE_DEL, KIND_REMOTE_INS, OpTensors
+from .span_arrays import FlatDoc, I32, U32
+
+_ROOT = jnp.uint32(ROOT_ORDER)
+
+
+def _row_of_order(doc: FlatDoc, order: jax.Array) -> jax.Array:
+    """Row index of the item with dense id ``order`` (must exist).
+    The SpaceIndex lookup (`doc.rs:101-107`) as one equality-mask argmax."""
+    in_doc = jnp.arange(doc.capacity, dtype=I32) < doc.n
+    return jnp.argmax((doc.order == order) & in_doc).astype(I32)
+
+
+def _cursor_after(doc: FlatDoc, order: jax.Array) -> jax.Array:
+    """Raw cursor just after item ``order`` (`doc.rs:121-136`)."""
+    return jnp.where(order == _ROOT, 0, _row_of_order(doc, order) + 1)
+
+
+def _integrate_cursor(doc: FlatDoc, my_rank: jax.Array,
+                      origin_left: jax.Array, origin_right: jax.Array,
+                      active: jax.Array) -> jax.Array:
+    """YATA conflict scan (`doc.rs:167-234`): final insert row for a remote
+    run. Runs zero iterations unless there are concurrent same-origin items
+    (`doc.rs:192-194` notes they are rare)."""
+    cursor0 = _cursor_after(doc, origin_left)
+    left_cursor = cursor0
+
+    def cond(state):
+        cursor, scanning, scan_start, done = state
+        return ~done & (cursor < doc.n)
+
+    def body(state):
+        cursor, scanning, scan_start, done = state
+        c = jnp.clip(cursor, 0, doc.capacity - 1)
+        other_order = doc.order[c]
+        other_left = doc.origin_left[c]
+        other_right = doc.origin_right[c]
+        other_rank = doc.rank[c]
+        olc = _cursor_after(doc, other_left)
+        # Break conditions, in the reference's order (`doc.rs:183-222`).
+        brk = (other_order == origin_right) | (olc < left_cursor)
+        eq = ~brk & (olc == left_cursor)
+        gt = my_rank > other_rank          # name tiebreak (`doc.rs:206-209`)
+        brk = brk | (eq & ~gt & (origin_right == other_right))
+        starts_scan = eq & ~gt & (origin_right != other_right)
+        new_scan_start = jnp.where(starts_scan & ~scanning, cursor, scan_start)
+        new_scanning = jnp.where(
+            eq, jnp.where(gt, False, jnp.where(
+                origin_right == other_right, scanning, True)),
+            scanning,
+        )
+        return (jnp.where(brk, cursor, cursor + 1), new_scanning,
+                new_scan_start, brk)
+
+    init = (cursor0, jnp.asarray(False), cursor0, ~active)
+    cursor, scanning, scan_start, _ = lax.while_loop(cond, body, init)
+    return jnp.where(scanning, scan_start, cursor)
+
+
+def step(doc: FlatDoc, op) -> FlatDoc:
+    """Apply one compiled op (see ``batch.OpTensors``) to one document."""
+    cap = doc.capacity
+    j = jnp.arange(cap, dtype=I32)
+    in_doc = j < doc.n
+    live = in_doc & ~doc.deleted
+    is_local = op.kind == KIND_LOCAL
+    is_rins = op.kind == KIND_REMOTE_INS
+    is_rdel = op.kind == KIND_REMOTE_DEL
+    pos = op.pos.astype(I32)
+    dlen = op.del_len.astype(I32)
+    ilen = op.ins_len.astype(I32)
+
+    # ---- delete phase (tombstone flips, `span.rs:110-119`) ----------------
+    # Local: the del-span live-rank window (`mutations.rs:520-570` +
+    # `doc.rs:392-433`). Remote: the order-range mask — fragmentation in doc
+    # order (`doc.rs:311-334`) is free here. Already-deleted rows stay
+    # deleted (idempotence; excess counts are host-side double_deletes).
+    cum = jnp.cumsum(live.astype(I32))
+    local_mask = live & (cum > pos) & (cum <= pos + dlen)
+    remote_mask = in_doc & ((doc.order - op.del_target) < op.del_len)
+    deleted = doc.deleted | jnp.where(
+        is_local, local_mask, jnp.where(is_rdel, remote_mask, False))
+
+    # ---- insert phase -----------------------------------------------------
+    # Local cursor/origins from the content position (`doc.rs:435-464`):
+    # origin_left is the (pos-1)-th live item post-delete; origin_right is
+    # the raw successor *without skipping tombstones* (`doc.rs:452-453`).
+    live2 = in_doc & ~deleted
+    cum2 = jnp.cumsum(live2.astype(I32))
+    oli = jnp.searchsorted(cum2, pos, side="left").astype(I32)
+    l_cursor = jnp.where(pos == 0, 0, oli + 1)
+    l_origin_left = jnp.where(
+        pos == 0, _ROOT, doc.order[jnp.clip(oli, 0, cap - 1)])
+    # Remote cursor from the integrate scan at resolved origins.
+    r_cursor = _integrate_cursor(
+        doc, op.rank, op.origin_left, op.origin_right, is_rins)
+
+    cursor = jnp.where(is_rins, r_cursor, l_cursor)
+    origin_left = jnp.where(is_rins, op.origin_left, l_origin_left)
+    safe_cursor = jnp.clip(cursor, 0, cap - 1)
+    l_origin_right = jnp.where(cursor < doc.n, doc.order[safe_cursor], _ROOT)
+    origin_right = jnp.where(is_rins, op.origin_right, l_origin_right)
+
+    # Splice: one gather through a shifted index map (`mutations.rs:17-179`
+    # without the node splits), then fill the new run with the implicit
+    # origin chain (`span.rs:9-13,24-28`).
+    src = jnp.clip(jnp.where(j < cursor, j, j - ilen), 0, cap - 1)
+    in_new = (j >= cursor) & (j < cursor + ilen)
+    k = j - cursor
+    ku = k.astype(U32)
+    new_order = op.ins_order_start + ku
+    take = lambda a: a[src]
+    return FlatDoc(
+        order=jnp.where(in_new, new_order, take(doc.order)),
+        origin_left=jnp.where(
+            in_new, jnp.where(k == 0, origin_left, new_order - 1),
+            take(doc.origin_left)),
+        origin_right=jnp.where(in_new, origin_right, take(doc.origin_right)),
+        rank=jnp.where(in_new, op.rank, take(doc.rank)),
+        chars=jnp.where(
+            in_new, op.chars[jnp.clip(k, 0, op.chars.shape[-1] - 1)],
+            take(doc.chars)),
+        deleted=jnp.where(in_new, False, take(deleted)),
+        n=doc.n + ilen,
+        next_order=doc.next_order + op.order_advance,
+    )
+
+
+def _check_capacity(doc: FlatDoc, ops: OpTensors) -> None:
+    """Host-side overflow guard: the splice clips silently on device, so
+    exceeding the static capacity would corrupt, not crash."""
+    import numpy as np
+
+    need = np.asarray(doc.n).max() + np.asarray(ops.ins_len).sum(axis=0).max()
+    assert need <= doc.capacity, (
+        f"op stream needs {int(need)} rows but capacity is {doc.capacity}; "
+        f"allocate a larger FlatDoc"
+    )
+
+
+@jax.jit
+def _apply_ops(doc: FlatDoc, ops: OpTensors) -> FlatDoc:
+    def body(d, op):
+        return step(d, op), None
+
+    out, _ = lax.scan(body, doc, ops)
+    return out
+
+
+@jax.jit
+def _apply_ops_batch(docs: FlatDoc, ops: OpTensors) -> FlatDoc:
+    vstep = jax.vmap(step)
+
+    def body(d, op):
+        return vstep(d, op), None
+
+    out, _ = lax.scan(body, docs, ops)
+    return out
+
+
+def apply_ops(doc: FlatDoc, ops: OpTensors) -> FlatDoc:
+    """Apply a compiled step stream to one document (``lax.scan``)."""
+    _check_capacity(doc, ops)
+    return _apply_ops(doc, ops)
+
+
+def apply_ops_batch(docs: FlatDoc, ops: OpTensors) -> FlatDoc:
+    """Batched apply: ``docs`` has a leading doc axis, ``ops`` is time-major
+    [S, B, ...] (see ``batch.stack_ops``/``tile_ops``). The vmap'd step is
+    the north-star "one pass across thousands of docs" kernel shape."""
+    _check_capacity(docs, ops)
+    return _apply_ops_batch(docs, ops)

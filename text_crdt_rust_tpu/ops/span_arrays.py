@@ -1,0 +1,173 @@
+"""Flattened device state: the document body as struct-of-arrays columns.
+
+This is the TPU-native replacement for the reference's pointer B-tree of RLE
+``YjsSpan`` runs (`src/range_tree/`, `src/list/span.rs:6-119`): one row per
+*item* (character), in document order, tombstones in place. The reference's
+per-span implicit origin chain (`span.rs:9-18`, `origin_left_at_offset`
+`span.rs:24-28`) is materialized per item, so every split/append origin
+fix-up (`span.rs:33-45,68-85`) becomes plain index arithmetic, and the
+cursor total order (`cursor.rs:274-304`) collapses to integer comparison.
+
+Columns (all capacity-padded to a static shape for XLA):
+
+- ``order``        u32  dense op id of the item (`list/mod.rs:29-30`)
+- ``origin_left``  u32  per-item origin (chained within runs)
+- ``origin_right`` u32  shared across a run (`span.rs:15-18`)
+- ``rank``         u32  author agent's *name rank* — the device stand-in for
+                        the Yjs tiebreak on agent name (`doc.rs:206-209`);
+                        see ``batch.AgentTable``
+- ``chars``        u32  unicode codepoint (the reference drops text content
+                        with ``USE_INNER_ROPE=false``, `doc.rs:14-17`; we
+                        keep it so ``to_string`` works — column can be fed
+                        zeros when benchmarking for parity)
+- ``deleted``      bool tombstone flag — the sign bit of the reference's
+                        signed span len (`span.rs:110-119`)
+
+plus scalars ``n`` (live+tombstone rows) and ``next_order`` (next dense op
+id, `doc.rs:55-58` analog). Batched documents stack a leading axis on every
+field (vmap; sharded over the mesh's ``dp`` axis by ``parallel.mesh``).
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common import ROOT_ORDER
+
+U32 = jnp.uint32
+I32 = jnp.int32
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=[
+        "order", "origin_left", "origin_right", "rank", "chars", "deleted",
+        "n", "next_order",
+    ],
+    meta_fields=[],
+)
+@dataclasses.dataclass
+class FlatDoc:
+    """One (or a batch of) flattened CRDT document bodies."""
+
+    order: jax.Array        # u32[..., N]
+    origin_left: jax.Array  # u32[..., N]
+    origin_right: jax.Array  # u32[..., N]
+    rank: jax.Array         # u32[..., N]
+    chars: jax.Array        # u32[..., N]
+    deleted: jax.Array      # bool[..., N]
+    n: jax.Array            # i32[...]
+    next_order: jax.Array   # u32[...]
+
+    @property
+    def capacity(self) -> int:
+        return self.order.shape[-1]
+
+
+def make_flat_doc(capacity: int) -> FlatDoc:
+    """Empty document (`doc.rs:51-64` analog — frontier/logs live host-side,
+    SURVEY §7 'Frontier/DAG logic is branchy — keep on host')."""
+    full = jnp.full(capacity, ROOT_ORDER, dtype=U32)
+    return FlatDoc(
+        order=full,
+        origin_left=full,
+        origin_right=full,
+        rank=jnp.zeros(capacity, dtype=U32),
+        chars=jnp.zeros(capacity, dtype=U32),
+        deleted=jnp.zeros(capacity, dtype=jnp.bool_),
+        n=jnp.asarray(0, dtype=I32),
+        next_order=jnp.asarray(0, dtype=U32),
+    )
+
+
+def stack_docs(doc: FlatDoc, batch: int) -> FlatDoc:
+    """Replicate a single doc into a batch (leading axis)."""
+    return jax.tree.map(
+        lambda x: jnp.broadcast_to(x, (batch,) + x.shape), doc
+    )
+
+
+# -- host-side readback ------------------------------------------------------
+
+
+def download(doc: FlatDoc) -> dict:
+    """Device -> host: numpy columns truncated to the live row count.
+
+    The downloaded arrays *are* the wire format (SURVEY §2 `Rle` row: flat
+    sorted span arrays upload/download as-is).
+    """
+    n = int(doc.n)
+    return {
+        "order": np.asarray(doc.order[:n]),
+        "origin_left": np.asarray(doc.origin_left[:n]),
+        "origin_right": np.asarray(doc.origin_right[:n]),
+        "rank": np.asarray(doc.rank[:n]),
+        "chars": np.asarray(doc.chars[:n]),
+        "deleted": np.asarray(doc.deleted[:n]),
+        "next_order": int(doc.next_order),
+    }
+
+
+def to_string(doc: FlatDoc) -> str:
+    cols = download(doc)
+    live = ~cols["deleted"]
+    cps = cols["chars"][live]
+    return cps.astype("<u4").tobytes().decode("utf-32-le")
+
+
+def doc_spans(doc: FlatDoc) -> List[Tuple[int, int, int, int]]:
+    """Document body as maximally RLE-merged YjsSpan tuples — the canonical
+    compacted form every engine reports (predicate `span.rs:47-53`)."""
+    from ..utils.rle import merge_yjs_spans
+
+    cols = download(doc)
+    return merge_yjs_spans(
+        (int(cols["order"][i]), int(cols["origin_left"][i]),
+         int(cols["origin_right"][i]), -1 if cols["deleted"][i] else 1)
+        for i in range(len(cols["order"]))
+    )
+
+
+def upload_oracle(oracle, capacity: int, rank_of_agent: np.ndarray) -> FlatDoc:
+    """Host oracle document -> device state (resume/warm-start path).
+
+    ``rank_of_agent`` maps the oracle's dense agent ids to name ranks (see
+    ``batch.AgentTable``).
+    """
+    n = oracle.n
+    assert n <= capacity, f"doc ({n} rows) exceeds device capacity {capacity}"
+
+    def pad_u32(a, fill):
+        out = np.full(capacity, fill, dtype=np.uint32)
+        out[:n] = a[:n]
+        return jnp.asarray(out)
+
+    # Per-item author rank: one vectorized searchsorted of item orders
+    # against the client_with_order run starts (`list/mod.rs:58-63`).
+    run_starts = np.asarray(
+        [e.order for e in oracle.client_with_order], dtype=np.int64)
+    run_agents = np.asarray(
+        [e.agent for e in oracle.client_with_order], dtype=np.int64)
+    run_idx = np.searchsorted(
+        run_starts, oracle.order[:n].astype(np.int64), side="right") - 1
+    ranks = np.asarray(rank_of_agent)[run_agents[run_idx]].astype(np.uint32)
+    return FlatDoc(
+        order=pad_u32(oracle.order, ROOT_ORDER),
+        origin_left=pad_u32(oracle.origin_left, ROOT_ORDER),
+        origin_right=pad_u32(oracle.origin_right, ROOT_ORDER),
+        rank=pad_u32(ranks, 0),
+        chars=pad_u32(oracle.chars, 0),
+        deleted=jnp.asarray(
+            np.concatenate([
+                oracle.deleted[:n],
+                np.zeros(capacity - n, dtype=bool),
+            ])
+        ),
+        n=jnp.asarray(n, dtype=I32),
+        next_order=jnp.asarray(oracle.get_next_order(), dtype=U32),
+    )
