@@ -1,0 +1,117 @@
+"""North-star benchmark: automerge-paper replay tiled across a doc batch.
+
+Replays a prefix of the automerge-paper editing trace (the
+`benches/yjs.rs:32-49` workload) across ``--batch`` identical documents on
+the device engine, all docs advanced per step by one vmapped+scanned apply
+kernel. Reports aggregate CRDT ops/sec/chip.
+
+Baseline: 0.29 M ops/s single-core on the native C++ engine replaying the
+full trace (BASELINE.md, measured); ``vs_baseline`` is the ratio against
+that row. Prints exactly ONE JSON line on stdout.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+import numpy as np
+
+from text_crdt_rust_tpu.ops import batch as B
+from text_crdt_rust_tpu.ops import flat as F
+from text_crdt_rust_tpu.ops import span_arrays as SA
+from text_crdt_rust_tpu.utils.testdata import (
+    flatten_patches,
+    load_testing_data,
+    trace_path,
+)
+
+CPU_BASELINE_OPS_PER_SEC = 290_000.0  # BASELINE.md automerge-paper row
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def expected_content(patches) -> str:
+    s = ""
+    for p in patches:
+        s = s[:p.pos] + p.ins_content + s[p.pos + p.del_len:]
+    return s
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trace", default="automerge-paper")
+    ap.add_argument("--patches", type=int, default=8000,
+                    help="trace prefix length (full trace: 0)")
+    ap.add_argument("--batch", type=int, default=1024)
+    ap.add_argument("--lmax", type=int, default=16)
+    args = ap.parse_args()
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform} {dev.device_kind}")
+
+    data = load_testing_data(trace_path(args.trace))
+    patches = flatten_patches(data)
+    if args.patches:
+        patches = patches[:args.patches]
+    n_ops = len(patches)
+    ins_total = sum(len(p.ins_content) for p in patches)
+    capacity = 1 << int(np.ceil(np.log2(max(ins_total, 64))))
+    ops, _ = B.compile_local_patches(patches, lmax=args.lmax)
+    steps = ops.num_steps
+    log(f"{args.trace}[:{n_ops}] -> {steps} device steps, "
+        f"capacity {capacity}, batch {args.batch}")
+
+    # Identical docs share one op stream: vmap with in_axes=None keeps the
+    # uploaded stream at [S, ...] (no host-side tiling, ~MBs not GBs).
+    vstep = jax.vmap(F.step, in_axes=(0, None))
+
+    @jax.jit
+    def replay(docs, ops):
+        def body(d, op):
+            return vstep(d, op), None
+
+        out, _ = jax.lax.scan(body, docs, ops)
+        return out
+
+    docs = SA.stack_docs(SA.make_flat_doc(capacity), args.batch)
+    ops = jax.device_put(ops)
+    docs = jax.device_put(docs)
+
+    log("compiling...")
+    t0 = time.perf_counter()
+    out = replay(docs, ops)
+    jax.block_until_ready(out)
+    t_first = time.perf_counter() - t0
+    log(f"first run (incl. compile): {t_first:.2f}s")
+
+    t0 = time.perf_counter()
+    out = replay(docs, ops)
+    jax.block_until_ready(out)
+    wall = time.perf_counter() - t0
+
+    # Correctness: every doc must equal the plain-string replay
+    # (`benches/yjs.rs:46` asserts final length each iteration).
+    want = expected_content(patches)
+    got = SA.to_string(jax.tree.map(lambda x: x[0], out))
+    assert got == want, "device replay diverged from string oracle"
+    assert int(np.asarray(out.n).min()) == int(np.asarray(out.n).max())
+
+    total_ops = n_ops * args.batch
+    ops_per_sec = total_ops / wall
+    log(f"wall {wall:.3f}s, {total_ops} ops -> {ops_per_sec:,.0f} ops/s")
+
+    print(json.dumps({
+        "metric": "crdt_ops_per_sec_chip",
+        "value": round(ops_per_sec, 1),
+        "unit": "ops/s",
+        "vs_baseline": round(ops_per_sec / CPU_BASELINE_OPS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

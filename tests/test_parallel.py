@@ -1,0 +1,255 @@
+"""Multi-chip sharding + causal streaming tests (virtual 8-device CPU mesh).
+
+Validates the same path the driver's ``dryrun_multichip`` exercises: real
+dp/sp shardings over a ``jax.sharding.Mesh``, one full apply step, results
+bit-equal to the unsharded engine and the host oracle.
+"""
+import random
+
+import jax
+import pytest
+
+from text_crdt_rust_tpu.common import (
+    RemoteId,
+    RemoteIns,
+    RemoteTxn,
+)
+from text_crdt_rust_tpu.models.oracle import ListCRDT
+from text_crdt_rust_tpu.models.sync import export_txns_since
+from text_crdt_rust_tpu.ops import batch as B
+from text_crdt_rust_tpu.ops import flat as F
+from text_crdt_rust_tpu.ops import span_arrays as SA
+from text_crdt_rust_tpu.parallel import (
+    CausalBuffer,
+    make_mesh,
+    make_sharded_apply,
+    shard_docs,
+    shard_ops,
+)
+from text_crdt_rust_tpu.parallel.mesh import make_sharded_apply_1doc
+
+from test_device_flat import (
+    jax_tree_index,
+    oracle_from_patches,
+    random_patches,
+)
+
+
+class TestMesh:
+    def test_devices_available(self):
+        assert len(jax.devices()) == 8, (
+            "conftest must force an 8-device CPU mesh")
+
+    @pytest.mark.parametrize("dp,sp", [(8, 1), (4, 2), (2, 4)])
+    def test_sharded_batch_matches_unsharded(self, dp, sp):
+        rng = random.Random(31)
+        patches, content = random_patches(rng, 40)
+        ops, _ = B.compile_local_patches(patches, lmax=4)
+        batch = 8
+        batched = B.tile_ops(ops, batch)
+        docs = SA.stack_docs(SA.make_flat_doc(256), batch)
+
+        mesh = make_mesh(dp=dp, sp=sp)
+        sharded_docs = shard_docs(docs, mesh)
+        sharded_ops = shard_ops(batched, mesh)
+        apply_fn = make_sharded_apply(mesh, donate=False)
+        out = apply_fn(sharded_docs, sharded_ops)
+
+        ref = F.apply_ops_batch(docs, batched)
+        for i in range(batch):
+            a = jax_tree_index(out, i)
+            b = jax_tree_index(ref, i)
+            assert SA.to_string(a) == SA.to_string(b) == content
+            assert SA.doc_spans(a) == SA.doc_spans(b)
+
+    def test_seq_parallel_one_doc(self):
+        # Long-context path: ONE document's item axis sharded over all 8
+        # chips (SURVEY §5 long-context row).
+        rng = random.Random(41)
+        patches, content = random_patches(rng, 60)
+        ops, _ = B.compile_local_patches(patches, lmax=4)
+        mesh = make_mesh(dp=1, sp=8)
+        doc = shard_docs(SA.make_flat_doc(512), mesh, batched=False)
+        apply_fn = make_sharded_apply_1doc(mesh)
+        out = apply_fn(doc, shard_ops(ops, mesh, batched=False))
+        assert SA.to_string(out) == content
+
+    def test_remote_ops_sharded(self):
+        # The YATA integrate while_loop must also compile under sharding.
+        rng = random.Random(51)
+        pa, _ = random_patches(rng, 30)
+        pb, _ = random_patches(rng, 30)
+        a = oracle_from_patches(pa, agent="peer-a")
+        bdoc = oracle_from_patches(pb, agent="peer-b")
+        txns = export_txns_since(a, 0) + export_txns_since(bdoc, 0)
+        oracle = ListCRDT()
+        for t in txns:
+            oracle.apply_remote_txn(t)
+
+        table = B.AgentTable(["peer-a", "peer-b"])
+        ops, _ = B.compile_remote_txns(txns, table, lmax=4)
+        batch = 4
+        batched = B.tile_ops(ops, batch)
+        docs = SA.stack_docs(SA.make_flat_doc(512), batch)
+        mesh = make_mesh(dp=4, sp=2)
+        out = make_sharded_apply(mesh, donate=False)(
+            shard_docs(docs, mesh), shard_ops(batched, mesh))
+        for i in range(batch):
+            one = jax_tree_index(out, i)
+            assert SA.to_string(one) == oracle.to_string()
+
+
+def _txn(agent, seq, parents, text, left=None):
+    root = RemoteId("ROOT", 0xFFFFFFFF)
+    return RemoteTxn(
+        id=RemoteId(agent, seq), parents=parents,
+        ops=[RemoteIns(left or root, root, text)],
+    )
+
+
+class TestCausalBuffer:
+    def test_in_order_passthrough(self):
+        buf = CausalBuffer()
+        t0 = _txn("amy", 0, [], "aa")
+        t1 = _txn("amy", 2, [RemoteId("amy", 1)], "bb",
+                  left=RemoteId("amy", 1))
+        assert buf.add(t0) == [t0]
+        assert buf.add(t1) == [t1]
+        assert buf.pending == 0
+
+    def test_reorder_released_in_causal_order(self):
+        buf = CausalBuffer()
+        t0 = _txn("amy", 0, [], "aa")
+        t1 = _txn("amy", 2, [RemoteId("amy", 1)], "bb",
+                  left=RemoteId("amy", 1))
+        assert buf.add(t1) == []          # arrives first, held
+        assert buf.pending == 1
+        assert buf.add(t0) == [t0, t1]    # unblocks both, in causal order
+        assert buf.pending == 0
+
+    def test_cross_agent_parent_dependency(self):
+        buf = CausalBuffer()
+        base = _txn("amy", 0, [], "aa")
+        child = _txn("bob", 0, [RemoteId("amy", 1)], "bb",
+                     left=RemoteId("amy", 1))
+        assert buf.add(child) == []       # parent unknown
+        assert buf.missing() == [RemoteId("amy", 0)]
+        assert buf.add(base) == [base, child]
+
+    def test_duplicates_dropped(self):
+        buf = CausalBuffer()
+        t0 = _txn("amy", 0, [], "aa")
+        assert buf.add(t0) == [t0]
+        assert buf.add(t0) == []          # replayed delivery
+
+    def test_blocked_duplicates_not_buffered(self):
+        # Re-delivery of a still-blocked txn must not grow the buffer.
+        buf = CausalBuffer()
+        child = _txn("bob", 0, [RemoteId("amy", 1)], "bb",
+                     left=RemoteId("amy", 1))
+        for _ in range(5):
+            assert buf.add(child) == []
+        assert buf.pending == 1
+        base = _txn("amy", 0, [], "aa")
+        assert buf.add(base) == [base, child]
+        assert buf.pending == 0
+
+    def test_partially_known_txn_split_not_dropped(self):
+        # Regression: a re-sync can deliver ONE txn covering seqs the buffer
+        # already released plus new ones (the source's txns RLE merges
+        # linear history, `txn.rs:38-42`). The unknown suffix must be
+        # released, not silently dropped as a duplicate.
+        src = ListCRDT()
+        a = src.get_or_create_agent_id("amy")
+        src.local_insert(a, 0, "aa")
+        early = export_txns_since(src, 0)
+        src.local_insert(a, 2, "bb")
+        merged = export_txns_since(src, 0)   # one txn covering seqs 0..4
+        assert len(merged) == 1
+
+        buf = CausalBuffer()
+        dst = ListCRDT()
+        for t in buf.add_all(early) + buf.add(merged[0]):
+            dst.apply_remote_txn(t)
+        assert buf.pending == 0
+        assert buf.missing() == []
+        assert dst.to_string() == "aabb"
+
+    def test_same_id_redelivery_keeps_longer(self):
+        # Two deliveries share id (amy,0) — an early export and a later
+        # RLE-merged one covering more seqs (`txn.rs:38-42`). The longer
+        # one supersedes the shorter in the buffer.
+        root = RemoteId("ROOT", 0xFFFFFFFF)
+        zed = _txn("zed", 0, [], "z")
+        t0 = RemoteTxn(
+            id=RemoteId("amy", 0), parents=[RemoteId("zed", 0)],
+            ops=[RemoteIns(root, root, "aa")])
+        t01 = RemoteTxn(
+            id=RemoteId("amy", 0), parents=[RemoteId("zed", 0)],
+            ops=[RemoteIns(root, root, "aa"),
+                 RemoteIns(RemoteId("amy", 1), root, "bb")])
+
+        expected = ListCRDT()
+        for t in (zed, t01):
+            expected.apply_remote_txn(t)
+
+        buf = CausalBuffer()
+        assert buf.add(t0) == []     # parent (zed,0) unknown
+        assert buf.add(t01) == []    # same id: replaces the shorter t0
+        assert buf.pending == 1
+        out = buf.add(zed)
+        assert [(t.id.agent, t.id.seq) for t in out] == [
+            ("zed", 0), ("amy", 0)]
+        dst = ListCRDT()
+        for t in out:
+            dst.apply_remote_txn(t)
+        assert dst.to_string() == expected.to_string()
+
+    def test_pending_txn_retrimmed_when_watermark_moves(self):
+        # A pending txn (distinct id) partially overlapped by a merged
+        # delivery that releases first: the pending one must be re-trimmed
+        # to its unknown suffix, not dropped.
+        from text_crdt_rust_tpu.common import split_txn_suffix
+        root = RemoteId("ROOT", 0xFFFFFFFF)
+        zed = _txn("zed", 0, [], "z")
+        t_merged = RemoteTxn(
+            id=RemoteId("amy", 0), parents=[],
+            ops=[RemoteIns(root, root, "aa"),
+                 RemoteIns(RemoteId("amy", 1), root, "bb")])   # seqs 0..4
+        t_late = RemoteTxn(
+            id=RemoteId("amy", 2), parents=[RemoteId("zed", 0)],
+            ops=[RemoteIns(RemoteId("amy", 1), root, "bb"),
+                 RemoteIns(RemoteId("amy", 3), root, "cc")])   # seqs 2..6
+
+        buf = CausalBuffer()
+        assert buf.add(t_late) == []       # gap + unknown parent
+        out = buf.add(t_merged)            # covers 0..4; t_late trims to 4..6
+        assert [(t.id.agent, t.id.seq) for t in out] == [("amy", 0),
+                                                         ("amy", 4)]
+        assert buf.pending == 0
+
+        expected = ListCRDT()
+        for t in (t_merged, split_txn_suffix(t_late, 2)):
+            expected.apply_remote_txn(t)
+        dst = ListCRDT()
+        for t in out:
+            dst.apply_remote_txn(t)
+        assert dst.to_string() == expected.to_string() == "aabbcc"
+
+    def test_random_shuffle_replays_whole_history(self):
+        rng = random.Random(77)
+        patches, content = random_patches(rng, 50)
+        src = oracle_from_patches(patches, agent="shuf")
+        txns = export_txns_since(src, 0)
+        shuffled = txns[:]
+        rng.shuffle(shuffled)
+        buf = CausalBuffer()
+        dst = ListCRDT()
+        applied = 0
+        for t in shuffled:
+            for ready in buf.add(t):
+                dst.apply_remote_txn(ready)
+                applied += 1
+        assert buf.pending == 0
+        assert applied == len(txns)
+        assert dst.to_string() == content

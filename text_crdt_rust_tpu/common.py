@@ -88,3 +88,54 @@ class RemoteTxn:
     id: RemoteId
     parents: List[RemoteId] = field(default_factory=list)
     ops: List[RemoteOp] = field(default_factory=list)
+
+
+def txn_len(txn: RemoteTxn) -> int:
+    """Total item count of a txn = seqs it consumes (`doc.rs:252-257`):
+    inserts consume one seq per char, deletes one per deleted item."""
+    return sum(
+        len(op.ins_content) if isinstance(op, RemoteIns) else op.len
+        for op in txn.ops
+    )
+
+
+def split_txn_suffix(txn: RemoteTxn, at: int) -> RemoteTxn:
+    """The suffix of ``txn`` starting ``at`` ops in (0 < at < txn_len).
+
+    Valid because within one txn, seqs and op offsets advance together
+    (`doc.rs:252-269`). Used when merging history that is already partially
+    known (`models.sync.merge_into`, `parallel.causal.CausalBuffer`).
+    """
+    agent = txn.id.agent
+    consumed = 0
+    suffix_ops: List[RemoteOp] = []
+    for op in txn.ops:
+        ln = len(op.ins_content) if isinstance(op, RemoteIns) else op.len
+        if consumed + ln <= at:
+            consumed += ln
+            continue
+        if consumed >= at:
+            suffix_ops.append(op)
+            consumed += ln
+            continue
+        # Split this op.
+        off = at - consumed
+        if isinstance(op, RemoteIns):
+            suffix_ops.append(RemoteIns(
+                # Implicit chain: predecessor is (agent, seq+at-1)
+                # (`span.rs:24-28`).
+                origin_left=RemoteId(agent, txn.id.seq + at - 1),
+                origin_right=op.origin_right,
+                ins_content=op.ins_content[off:],
+            ))
+        else:
+            suffix_ops.append(RemoteDel(
+                id=RemoteId(op.id.agent, op.id.seq + off),
+                len=op.len - off,
+            ))
+        consumed += ln
+    return RemoteTxn(
+        id=RemoteId(agent, txn.id.seq + at),
+        parents=[RemoteId(agent, txn.id.seq + at - 1)],
+        ops=suffix_ops,
+    )

@@ -22,6 +22,8 @@ from ..common import (
     RemoteId,
     RemoteIns,
     RemoteTxn,
+    split_txn_suffix,
+    txn_len,
 )
 from .oracle import ListCRDT
 
@@ -118,49 +120,6 @@ def export_txns_since(doc: ListCRDT, start_order: int = 0) -> List[RemoteTxn]:
     return out
 
 
-def _split_txn_at(txn: RemoteTxn, at: int) -> RemoteTxn:
-    """Return the suffix of ``txn`` starting ``at`` ops in (0 < at < len).
-
-    Valid because within one exported txn, seqs and op offsets advance
-    together (`doc.rs:252-269`)."""
-    agent = txn.id.agent
-    consumed = 0
-    suffix_ops: List = []
-    for op in txn.ops:
-        if isinstance(op, RemoteIns):
-            ln = len(op.ins_content)
-        else:
-            ln = op.len
-        if consumed + ln <= at:
-            consumed += ln
-            continue
-        if consumed >= at:
-            suffix_ops.append(op)
-            consumed += ln
-            continue
-        # Split this op.
-        off = at - consumed
-        if isinstance(op, RemoteIns):
-            suffix_ops.append(RemoteIns(
-                # Implicit chain: predecessor is (agent, seq+at-1)
-                # (`span.rs:24-28`).
-                origin_left=RemoteId(agent, txn.id.seq + at - 1),
-                origin_right=op.origin_right,
-                ins_content=op.ins_content[off:],
-            ))
-        else:
-            suffix_ops.append(RemoteDel(
-                id=RemoteId(op.id.agent, op.id.seq + off),
-                len=op.len - off,
-            ))
-        consumed += ln
-    return RemoteTxn(
-        id=RemoteId(agent, txn.id.seq + at),
-        parents=[RemoteId(agent, txn.id.seq + at - 1)],
-        ops=suffix_ops,
-    )
-
-
 def merge_into(dst: ListCRDT, src: ListCRDT) -> int:
     """Apply everything ``dst`` is missing from ``src``'s history.
 
@@ -171,13 +130,10 @@ def merge_into(dst: ListCRDT, src: ListCRDT) -> int:
     for txn in export_txns_since(src, 0):
         agent = dst.get_or_create_agent_id(txn.id.agent)
         next_seq = dst.client_data[agent].get_next_seq()
-        txn_len = 0
-        for op in txn.ops:
-            txn_len += len(op.ins_content) if isinstance(op, RemoteIns) else op.len
-        if txn.id.seq + txn_len <= next_seq:
+        if txn.id.seq + txn_len(txn) <= next_seq:
             continue  # fully known
         if txn.id.seq < next_seq:
-            txn = _split_txn_at(txn, next_seq - txn.id.seq)
+            txn = split_txn_suffix(txn, next_seq - txn.id.seq)
         dst.apply_remote_txn(txn)
         applied += 1
     return applied

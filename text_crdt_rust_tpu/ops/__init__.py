@@ -6,8 +6,9 @@ see ``span_arrays``):
 - ``flat``    — correctness-first engine: per-item arrays in document order,
                 every op is O(capacity) fully-vectorized work. Supports the
                 complete op surface (local edits, remote inserts with the
-                YATA integrate scan + name-rank tiebreak, remote deletes with
-                double-delete detection). The device twin of
+                YATA integrate scan + name-rank tiebreak, remote delete
+                tombstoning — excess-delete *counts* stay in the host-side
+                double_deletes log). The device twin of
                 ``models.oracle.ListCRDT``.
 - ``blocked`` — throughput engine for the north-star trace-replay path:
                 the document is a fixed grid of blocks; each op touches one

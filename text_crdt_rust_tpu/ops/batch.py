@@ -38,6 +38,7 @@ from ..common import (
     RemoteId,
     RemoteIns,
     RemoteTxn,
+    txn_len,
 )
 from ..utils.rle import KOrderSpan, Rle
 from ..utils.testdata import TestData, TestPatch, flatten_patches
@@ -291,14 +292,11 @@ def compile_remote_txns(
             f"{assigner.next_seq(agent)}, got {txn.id.seq} "
             f"(buffer with parallel.causal.CausalBuffer)"
         )
-        txn_len = sum(
-            len(op.ins_content) if isinstance(op, RemoteIns) else op.len
-            for op in txn.ops
-        )
-        assert txn_len > 0, "empty remote txn"
+        length = txn_len(txn)
+        assert length > 0, "empty remote txn"
         # Orders for the whole txn are allocated up front (`doc.rs:265-269`)
         # so intra-txn origin references resolve.
-        cursor = assigner.assign(agent, txn.id.seq, txn_len)
+        cursor = assigner.assign(agent, txn.id.seq, length)
         for op in txn.ops:
             if isinstance(op, RemoteIns):
                 ins = op.ins_content
@@ -323,14 +321,14 @@ def compile_remote_txns(
             else:
                 assert isinstance(op, RemoteDel)
                 target_agent = table.id_of(op.id.agent)
-                for first, length in assigner.target_runs(
+                for first, run_len in assigner.target_runs(
                         target_agent, op.id.seq, op.len):
                     rows.emit(
                         kind=KIND_REMOTE_DEL, del_target=first,
-                        del_len=length, order_advance=length,
+                        del_len=run_len, order_advance=run_len,
                         rank=int(ranks[agent]),
                     )
-                    cursor += length
+                    cursor += run_len
     return rows.to_tensors(), assigner
 
 

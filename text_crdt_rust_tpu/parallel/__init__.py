@@ -1,0 +1,29 @@
+"""Parallel execution layer: device-mesh sharding + causal streaming.
+
+The reference is a single-threaded CPU library (SURVEY §2 "Parallelism
+inventory": no DP/TP/PP/SP and no NCCL/MPI anywhere); its only concurrency is
+the *logical* concurrency of CRDT editors. This package supplies the
+net-new, first-class parallel components the TPU build requires:
+
+- ``mesh``    — document-batch data parallelism (the DP analog) and
+                capacity-axis sharding (the SP/long-context analog) over a
+                ``jax.sharding.Mesh``, with XLA inserting the collectives.
+- ``causal``  — the causal receive buffer for out-of-order remote txns (the
+                reference's "we either need to skip or buffer" gap,
+                `doc.rs:246-247`).
+"""
+from .causal import CausalBuffer
+from .mesh import (
+    make_mesh,
+    make_sharded_apply,
+    shard_docs,
+    shard_ops,
+)
+
+__all__ = [
+    "CausalBuffer",
+    "make_mesh",
+    "make_sharded_apply",
+    "shard_docs",
+    "shard_ops",
+]

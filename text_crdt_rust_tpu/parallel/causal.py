@@ -1,0 +1,133 @@
+"""Causal receive buffer for out-of-order remote transactions.
+
+The reference asserts remote txns arrive in per-agent seq order and leaves a
+TODO: "we either need to skip or buffer the transaction" (`doc.rs:246-247`).
+This module implements that buffer (SURVEY §5 "Failure detection" row): txns
+are held until *causally ready* — every parent known and the author's seq
+contiguous — then released in a deterministic causal order. It fronts both
+the host oracle (``ListCRDT.apply_remote_txn``) and the device op compiler
+(``ops.batch.compile_remote_txns``), which both hard-assert readiness.
+
+Readiness (`doc.rs:242-269` preconditions):
+- ``txn.id.seq`` == the author's next expected seq (no gaps in an agent's
+  op stream; seqs within a txn advance by its op length, `doc.rs:252-269`);
+- every parent id is ROOT or already released (parents are (agent, seq)
+  pairs; known iff seq < that agent's released watermark).
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List
+
+from ..common import RemoteId, RemoteTxn, split_txn_suffix, txn_len
+
+
+class CausalBuffer:
+    """Holds remote txns until causally ready; releases them in order.
+
+    ``add``/``add_all`` return the txns that became ready (possibly
+    including earlier-buffered ones), in a valid causal order. Duplicate
+    and already-known txns are dropped, mirroring the idempotent re-sync
+    behavior peers need (`README.md:33-35` peer model).
+    """
+
+    def __init__(self) -> None:
+        # Agent name -> next expected seq (the released watermark).
+        self._next_seq: Dict[str, int] = {}
+        self._pending: List[RemoteTxn] = []
+
+    def _watermark(self, agent: str) -> int:
+        return self._next_seq.get(agent, 0)
+
+    def _known(self, rid: RemoteId) -> bool:
+        if rid.agent == "ROOT":
+            return True
+        return rid.seq < self._watermark(rid.agent)
+
+    def _ready(self, txn: RemoteTxn) -> bool:
+        if txn.id.seq != self._watermark(txn.id.agent):
+            return False
+        return all(self._known(p) for p in txn.parents)
+
+    def _trim(self, txn: RemoteTxn) -> RemoteTxn | None:
+        """Drop the already-released prefix of ``txn`` (re-sync deliveries
+        may cover known seqs — a peer's txns RLE merges linear history, so
+        a later export can span an older one, `txn.rs:38-42`). Returns None
+        if fully known."""
+        wm = self._watermark(txn.id.agent)
+        if txn.id.seq + txn_len(txn) <= wm:
+            return None  # duplicate / fully released
+        if txn.id.seq < wm:
+            return split_txn_suffix(txn, wm - txn.id.seq)
+        return txn
+
+    def add(self, txn: RemoteTxn) -> List[RemoteTxn]:
+        """Offer one txn; return every txn that is now ready, causal order."""
+        trimmed = self._trim(txn)
+        if trimmed is None:
+            return []
+        # Re-delivery of a still-blocked txn (peers re-sync while a parent
+        # is missing) must not grow the buffer: one entry per (agent, seq),
+        # keeping the longer delivery (a merged export supersedes a prefix).
+        for i, held in enumerate(self._pending):
+            if held.id == trimmed.id:
+                if txn_len(trimmed) > txn_len(held):
+                    self._pending[i] = trimmed
+                    return self._drain()
+                return []
+        self._pending.append(trimmed)
+        return self._drain()
+
+    def add_all(self, txns: Iterable[RemoteTxn]) -> List[RemoteTxn]:
+        out: List[RemoteTxn] = []
+        for t in txns:
+            out.extend(self.add(t))
+        return out
+
+    def _drain(self) -> List[RemoteTxn]:
+        released: List[RemoteTxn] = []
+        progressed = True
+        while progressed:
+            progressed = False
+            for i, txn in enumerate(self._pending):
+                if txn.id.seq < self._watermark(txn.id.agent):
+                    # Watermark moved while buffered: re-trim (overlapping
+                    # delivery) or drop (duplicate).
+                    self._pending.pop(i)
+                    trimmed = self._trim(txn)
+                    if trimmed is not None:
+                        self._pending.insert(i, trimmed)
+                    progressed = True
+                    break
+                if self._ready(txn):
+                    self._pending.pop(i)
+                    self._next_seq[txn.id.agent] = txn.id.seq + txn_len(txn)
+                    released.append(txn)
+                    progressed = True
+                    break
+        return released
+
+    @property
+    def pending(self) -> int:
+        """Buffered txns still waiting on causal dependencies."""
+        return len(self._pending)
+
+    def missing(self) -> List[RemoteId]:
+        """The frontier of unmet dependencies — the first unreceived
+        (agent, seq) per blocking agent, i.e. what to request from peers
+        (failure detection: a persistently-missing id marks a lost txn)."""
+        out: List[RemoteId] = []
+        seen = set()
+
+        def want(agent: str) -> None:
+            rid = RemoteId(agent, self._watermark(agent))
+            if agent != "ROOT" and rid not in seen:
+                seen.add(rid)
+                out.append(rid)
+
+        for txn in self._pending:
+            if txn.id.seq > self._watermark(txn.id.agent):
+                want(txn.id.agent)  # gap in the author's own stream
+            for p in txn.parents:
+                if not self._known(p):
+                    want(p.agent)
+        return out
