@@ -15,6 +15,7 @@ import argparse
 import json
 import sys
 import time
+from functools import partial
 
 import jax
 import numpy as np
@@ -45,9 +46,9 @@ def expected_content(patches) -> str:
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--trace", default="automerge-paper")
-    ap.add_argument("--patches", type=int, default=8000,
+    ap.add_argument("--patches", type=int, default=2000,
                     help="trace prefix length (full trace: 0)")
-    ap.add_argument("--batch", type=int, default=1024)
+    ap.add_argument("--batch", type=int, default=4096)
     ap.add_argument("--lmax", type=int, default=16)
     args = ap.parse_args()
 
@@ -67,8 +68,9 @@ def main() -> None:
         f"capacity {capacity}, batch {args.batch}")
 
     # Identical docs share one op stream: vmap with in_axes=None keeps the
-    # uploaded stream at [S, ...] (no host-side tiling, ~MBs not GBs).
-    vstep = jax.vmap(F.step, in_axes=(0, None))
+    # uploaded stream at [S, ...] (no host-side tiling, ~MBs not GBs). The
+    # stream is pure local edits, so the remote paths compile out.
+    vstep = jax.vmap(partial(F.step, local_only=True), in_axes=(0, None))
 
     @jax.jit
     def replay(docs, ops):
@@ -78,7 +80,9 @@ def main() -> None:
         out, _ = jax.lax.scan(body, docs, ops)
         return out
 
-    docs = SA.stack_docs(SA.make_flat_doc(capacity), args.batch)
+    base = B.prefill_logs(SA.make_flat_doc(capacity), ops)
+    F._check_capacity(base, ops)
+    docs = SA.stack_docs(base, args.batch)
     ops = jax.device_put(ops)
     docs = jax.device_put(docs)
 

@@ -47,7 +47,8 @@ class TestMesh:
         ops, _ = B.compile_local_patches(patches, lmax=4)
         batch = 8
         batched = B.tile_ops(ops, batch)
-        docs = SA.stack_docs(SA.make_flat_doc(256), batch)
+        docs = SA.stack_docs(
+            B.prefill_logs(SA.make_flat_doc(256), ops), batch)
 
         mesh = make_mesh(dp=dp, sp=sp)
         sharded_docs = shard_docs(docs, mesh)
@@ -69,7 +70,8 @@ class TestMesh:
         patches, content = random_patches(rng, 60)
         ops, _ = B.compile_local_patches(patches, lmax=4)
         mesh = make_mesh(dp=1, sp=8)
-        doc = shard_docs(SA.make_flat_doc(512), mesh, batched=False)
+        doc = shard_docs(
+            B.prefill_logs(SA.make_flat_doc(512), ops), mesh, batched=False)
         apply_fn = make_sharded_apply_1doc(mesh)
         out = apply_fn(doc, shard_ops(ops, mesh, batched=False))
         assert SA.to_string(out) == content
@@ -90,7 +92,8 @@ class TestMesh:
         ops, _ = B.compile_remote_txns(txns, table, lmax=4)
         batch = 4
         batched = B.tile_ops(ops, batch)
-        docs = SA.stack_docs(SA.make_flat_doc(512), batch)
+        docs = SA.stack_docs(
+            B.prefill_logs(SA.make_flat_doc(512), ops), batch)
         mesh = make_mesh(dp=4, sp=2)
         out = make_sharded_apply(mesh, donate=False)(
             shard_docs(docs, mesh), shard_ops(batched, mesh))

@@ -332,6 +332,76 @@ def compile_remote_txns(
     return rows.to_tensors(), assigner
 
 
+# -- log prefill -------------------------------------------------------------
+
+
+def _prefill_one(ol, orr, rank, chars, ops: OpTensors) -> None:
+    """Scatter one unbatched op stream's compile-time-known log values
+    (in place, numpy). See ``prefill_logs``."""
+    ins_len = np.asarray(ops.ins_len, dtype=np.int64)
+    starts = np.asarray(ops.ins_order_start, dtype=np.int64)
+    kinds = np.asarray(ops.kind)
+    op_chars = np.asarray(ops.chars)
+    ranks = np.asarray(ops.rank)
+    ol_ops = np.asarray(ops.origin_left)
+    or_ops = np.asarray(ops.origin_right)
+
+    sel = ins_len > 0
+    if not sel.any():
+        return
+    reps = ins_len[sel]
+    total = int(reps.sum())
+    step_idx = np.repeat(np.nonzero(sel)[0], reps)
+    within = np.arange(total) - np.repeat(
+        np.cumsum(reps) - reps, reps)
+    pos = starts[sel].repeat(reps) + within
+
+    chars[pos] = op_chars[step_idx, within]
+    rank[pos] = ranks[step_idx]
+    # Within-run implicit origin chain (`span.rs:9-13,24-28`): item k's
+    # origin_left is order+k-1. The run head's origins are known at compile
+    # time only for remote inserts; local heads are written on device.
+    chain = within > 0
+    ol[pos[chain]] = (pos[chain] - 1).astype(np.uint32)
+    remote = kinds[step_idx] == KIND_REMOTE_INS
+    head = ~chain & remote
+    ol[pos[head]] = ol_ops[step_idx[head]]
+    orr[pos[remote]] = or_ops[step_idx[remote]]
+
+
+def prefill_logs(doc, ops: OpTensors):
+    """Fill a ``FlatDoc``'s by-order logs with everything the compiler
+    already knows about ``ops``: chars, author ranks, remote origins, and
+    every insert run's implicit origin chain. The device then only writes
+    the two origins a *local* insert discovers at apply time
+    (`doc.rs:447-453`).
+
+    ``ops`` may be unbatched ``[S, ...]`` (doc unbatched, or one stream
+    shared by every doc of a batched doc) or batched ``[S, B, ...]`` (doc
+    batched ``[B, ...]``). For identical fresh docs, prefilling before
+    ``stack_docs`` is cheaper (one pass, broadcast after).
+    Returns a new doc; host-side numpy work.
+    """
+    import jax.numpy as jnp
+
+    ops_batched = np.asarray(ops.kind).ndim == 2
+    ol = np.array(doc.ol_log)
+    orr = np.array(doc.or_log)
+    rank = np.array(doc.rank_log)
+    chars = np.array(doc.chars_log)
+    if ol.ndim == 1:
+        assert not ops_batched, "batched ops need a batched doc"
+        _prefill_one(ol, orr, rank, chars, ops)
+    else:
+        for b in range(ol.shape[0]):
+            per_doc = (jax.tree.map(lambda a: np.asarray(a)[:, b], ops)
+                       if ops_batched else ops)
+            _prefill_one(ol[b], orr[b], rank[b], chars[b], per_doc)
+    return dataclasses.replace(
+        doc, ol_log=jnp.asarray(ol), or_log=jnp.asarray(orr),
+        rank_log=jnp.asarray(rank), chars_log=jnp.asarray(chars))
+
+
 # -- batching ----------------------------------------------------------------
 
 

@@ -2,25 +2,31 @@
 
 The device twin of ``models.oracle.ListCRDT`` — same flattened item layout,
 same semantics, jit/vmap/scan-compatible. Each step is O(capacity) of
-branch-free vector work (XLA-fusable), so this engine is the *correctness*
-engine and the remote/concurrent path; ``ops.blocked`` is the throughput
-engine for the trace-replay hot path.
+branch-free vector work with **no arbitrary gathers** (TPU gathers run near
+one element/cycle and dominated the first version of this engine):
 
-How the reference's per-op O(log n) machinery maps here (SURVEY §7):
-
-- B-tree descent `root.rs:54-88` -> ``cumsum`` over the live mask +
-  ``searchsorted`` (position -> row);
+- B-tree descent `root.rs:54-88` -> one ``cumsum`` over the live mask + a
+  compare-and-sum (position -> row), instead of searchsorted's binary-search
+  gathers;
+- the splice `mutations.rs:17-179` -> a log2(lmax) chain of static
+  ``jnp.roll``s selected by the insert length's bits, plus iota arithmetic
+  for the new run (orders are consecutive, `span.rs:9-13`) — the entire
+  mutable state is the one ``signed`` column (see ``span_arrays``);
 - order -> leaf-ptr SpaceIndex `split_list/mod.rs:440` -> ``argmax`` over an
   equality mask (order -> row);
-- cursor total order `cursor.rs:274-304` -> integer comparison of rows;
+- tombstoning `span.rs:110-119` -> sign flip of ``signed`` (local deletes
+  select a live-rank window via the cumsum; remote deletes select an order
+  range, which also makes the fragmented-target walk `doc.rs:311-334` a
+  single mask op);
 - the YATA integrate scan `doc.rs:167-234` -> a ``lax.while_loop`` from the
-  origin cursor, with the name tiebreak on precompiled agent ranks and the
-  scanning/scan_start backtrack carried as loop state;
-- tombstoning `span.rs:110-119` -> boolean mask OR (local deletes select a
-  live-rank window; remote deletes select an order range, which also makes
-  the fragmented-target walk `doc.rs:311-334` a single mask op);
-- splice + node splits `mutations.rs:17-179,623-808` -> one gather with a
-  shifted index map (no splits: capacity is static).
+  origin cursor reading per-item origins/ranks through the by-order logs,
+  with the scanning/scan_start backtrack carried as loop state (scalar
+  reads; the loop runs zero iterations unless same-origin concurrent
+  inserts exist, `doc.rs:192-194`).
+
+Immutable per-item metadata (origins, ranks, chars) lives in by-order logs
+mostly prefilled host-side by the op compiler (``batch.prefill_logs``); a
+local-insert step writes only the two origins it discovers at apply time.
 
 Frontier/time-DAG bookkeeping stays host-side (``models.oracle`` /
 ``parallel.causal``), per SURVEY §7 "keep on host".
@@ -28,7 +34,6 @@ Frontier/time-DAG bookkeeping stays host-side (``models.oracle`` /
 from __future__ import annotations
 
 from functools import partial
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp
@@ -41,16 +46,33 @@ from .span_arrays import FlatDoc, I32, U32
 _ROOT = jnp.uint32(ROOT_ORDER)
 
 
+def _order_of(signed: jax.Array) -> jax.Array:
+    """Magnitude decode: row content -> order (u32; garbage on empty rows,
+    callers mask with ``signed != 0``)."""
+    return (jnp.abs(signed) - 1).astype(U32)
+
+
 def _row_of_order(doc: FlatDoc, order: jax.Array) -> jax.Array:
     """Row index of the item with dense id ``order`` (must exist).
     The SpaceIndex lookup (`doc.rs:101-107`) as one equality-mask argmax."""
-    in_doc = jnp.arange(doc.capacity, dtype=I32) < doc.n
-    return jnp.argmax((doc.order == order) & in_doc).astype(I32)
+    hit = (doc.signed != 0) & (_order_of(doc.signed) == order)
+    return jnp.argmax(hit).astype(I32)
 
 
 def _cursor_after(doc: FlatDoc, order: jax.Array) -> jax.Array:
     """Raw cursor just after item ``order`` (`doc.rs:121-136`)."""
     return jnp.where(order == _ROOT, 0, _row_of_order(doc, order) + 1)
+
+
+def _shift_right(col: jax.Array, ilen: jax.Array, lmax: int) -> jax.Array:
+    """``col`` shifted right by dynamic ``ilen`` (0..lmax) along the last
+    axis: a static roll per set bit — no gather. Wrapped-around garbage
+    lands below ``cursor + ilen`` where callers overwrite it."""
+    out = col
+    for b in range(max(lmax, 1).bit_length()):
+        out = jnp.where((ilen >> b) & 1 != 0,
+                        jnp.roll(out, 1 << b, axis=-1), out)
+    return out
 
 
 def _integrate_cursor(doc: FlatDoc, my_rank: jax.Array,
@@ -61,6 +83,10 @@ def _integrate_cursor(doc: FlatDoc, my_rank: jax.Array,
     (`doc.rs:192-194` notes they are rare)."""
     cursor0 = _cursor_after(doc, origin_left)
     left_cursor = cursor0
+    cap = doc.capacity
+
+    def read_log(log, order):
+        return log[jnp.clip(order.astype(I32), 0, doc.order_capacity - 1)]
 
     def cond(state):
         cursor, scanning, scan_start, done = state
@@ -68,11 +94,11 @@ def _integrate_cursor(doc: FlatDoc, my_rank: jax.Array,
 
     def body(state):
         cursor, scanning, scan_start, done = state
-        c = jnp.clip(cursor, 0, doc.capacity - 1)
-        other_order = doc.order[c]
-        other_left = doc.origin_left[c]
-        other_right = doc.origin_right[c]
-        other_rank = doc.rank[c]
+        c = jnp.clip(cursor, 0, cap - 1)
+        other_order = _order_of(doc.signed[c])
+        other_left = read_log(doc.ol_log, other_order)
+        other_right = read_log(doc.or_log, other_order)
+        other_rank = read_log(doc.rank_log, other_order)
         olc = _cursor_after(doc, other_left)
         # Break conditions, in the reference's order (`doc.rs:183-222`).
         brk = (other_order == origin_right) | (olc < left_cursor)
@@ -94,78 +120,121 @@ def _integrate_cursor(doc: FlatDoc, my_rank: jax.Array,
     return jnp.where(scanning, scan_start, cursor)
 
 
-def step(doc: FlatDoc, op) -> FlatDoc:
-    """Apply one compiled op (see ``batch.OpTensors``) to one document."""
+def step(doc: FlatDoc, op, local_only: bool = False) -> FlatDoc:
+    """Apply one compiled op (see ``batch.OpTensors``) to one document.
+
+    ``local_only=True`` (static) compiles out the remote paths — the YATA
+    while_loop and remote masks — for pure local-edit streams (the trace
+    replay hot path, `benches/yjs.rs:32-49`).
+    """
     cap = doc.capacity
+    # Shift budget and log-write window follow the op stream's static chunk
+    # width, so a compile-time lmax can never outrun the write window.
+    lmax = op.chars.shape[-1]
     j = jnp.arange(cap, dtype=I32)
-    in_doc = j < doc.n
-    live = in_doc & ~doc.deleted
     is_local = op.kind == KIND_LOCAL
     is_rins = op.kind == KIND_REMOTE_INS
     is_rdel = op.kind == KIND_REMOTE_DEL
     pos = op.pos.astype(I32)
     dlen = op.del_len.astype(I32)
-    ilen = op.ins_len.astype(I32)
+    ilen = jnp.where(is_rdel, 0, op.ins_len.astype(I32))
 
-    # ---- delete phase (tombstone flips, `span.rs:110-119`) ----------------
+    signed = doc.signed
+    live = signed > 0
+    cum = jnp.cumsum(live.astype(I32))
+
+    # ---- delete phase (tombstone sign flips, `span.rs:110-119`) -----------
     # Local: the del-span live-rank window (`mutations.rs:520-570` +
     # `doc.rs:392-433`). Remote: the order-range mask — fragmentation in doc
     # order (`doc.rs:311-334`) is free here. Already-deleted rows stay
     # deleted (idempotence; excess counts are host-side double_deletes).
-    cum = jnp.cumsum(live.astype(I32))
     local_mask = live & (cum > pos) & (cum <= pos + dlen)
-    remote_mask = in_doc & ((doc.order - op.del_target) < op.del_len)
-    deleted = doc.deleted | jnp.where(
-        is_local, local_mask, jnp.where(is_rdel, remote_mask, False))
+    if local_only:
+        del_mask = local_mask
+    else:
+        orders = _order_of(signed)
+        remote_mask = (signed != 0) & ((orders - op.del_target) < op.del_len)
+        del_mask = jnp.where(is_local, local_mask,
+                             jnp.where(is_rdel, remote_mask, False))
+    signed = jnp.where(del_mask, -jnp.abs(signed), signed)
+
+    # Post-delete live prefix counts, without a second cumsum: a local
+    # delete removes the live-rank window (pos, pos+dlen], so the first-i
+    # live count drops by clip(cum - pos, 0, dlen); remote deletes never
+    # precede an insert in the same step (KIND_REMOTE_DEL has ins_len 0).
+    cum2 = cum - jnp.where(is_local, jnp.clip(cum - pos, 0, dlen), 0)
 
     # ---- insert phase -----------------------------------------------------
     # Local cursor/origins from the content position (`doc.rs:435-464`):
     # origin_left is the (pos-1)-th live item post-delete; origin_right is
     # the raw successor *without skipping tombstones* (`doc.rs:452-453`).
-    live2 = in_doc & ~deleted
-    cum2 = jnp.cumsum(live2.astype(I32))
-    oli = jnp.searchsorted(cum2, pos, side="left").astype(I32)
+    # Predecessor row = first index whose live prefix count equals pos
+    # (compare-and-sum; no searchsorted gathers).
+    oli = jnp.sum((cum2 < pos).astype(I32))
+    safe_oli = jnp.clip(oli, 0, cap - 1)
     l_cursor = jnp.where(pos == 0, 0, oli + 1)
-    l_origin_left = jnp.where(
-        pos == 0, _ROOT, doc.order[jnp.clip(oli, 0, cap - 1)])
-    # Remote cursor from the integrate scan at resolved origins.
-    r_cursor = _integrate_cursor(
-        doc, op.rank, op.origin_left, op.origin_right, is_rins)
+    l_origin_left = jnp.where(pos == 0, _ROOT, _order_of(signed[safe_oli]))
 
-    cursor = jnp.where(is_rins, r_cursor, l_cursor)
-    origin_left = jnp.where(is_rins, op.origin_left, l_origin_left)
+    if local_only:
+        cursor = l_cursor
+        origin_left = l_origin_left
+    else:
+        doc_post_del = FlatDoc(
+            signed=signed, ol_log=doc.ol_log, or_log=doc.or_log,
+            rank_log=doc.rank_log, chars_log=doc.chars_log,
+            n=doc.n, next_order=doc.next_order,
+        )
+        r_cursor = _integrate_cursor(
+            doc_post_del, op.rank, op.origin_left, op.origin_right, is_rins)
+        cursor = jnp.where(is_rins, r_cursor, l_cursor)
+        origin_left = jnp.where(is_rins, op.origin_left, l_origin_left)
     safe_cursor = jnp.clip(cursor, 0, cap - 1)
-    l_origin_right = jnp.where(cursor < doc.n, doc.order[safe_cursor], _ROOT)
-    origin_right = jnp.where(is_rins, op.origin_right, l_origin_right)
+    l_origin_right = jnp.where(
+        cursor < doc.n, _order_of(signed[safe_cursor]), _ROOT)
+    if local_only:
+        origin_right = l_origin_right
+    else:
+        origin_right = jnp.where(is_rins, op.origin_right, l_origin_right)
 
-    # Splice: one gather through a shifted index map (`mutations.rs:17-179`
-    # without the node splits), then fill the new run with the implicit
-    # origin chain (`span.rs:9-13,24-28`).
-    src = jnp.clip(jnp.where(j < cursor, j, j - ilen), 0, cap - 1)
+    # Splice (`mutations.rs:17-179` without the node splits): rows >= cursor
+    # shift right by ilen via static rolls; the new run is iota arithmetic
+    # (+1 for the ±(order+1) encoding).
+    shifted = _shift_right(signed, ilen, lmax)
     in_new = (j >= cursor) & (j < cursor + ilen)
-    k = j - cursor
-    ku = k.astype(U32)
-    new_order = op.ins_order_start + ku
-    take = lambda a: a[src]
+    new_signed = (op.ins_order_start.astype(I32) + (j - cursor)) + 1
+    signed = jnp.where(j < cursor, signed,
+                       jnp.where(in_new, new_signed, shifted))
+
+    # Log writes for what only apply time knows: a local insert's origins
+    # (`doc.rs:447-453`). The within-run chain and everything remote is
+    # prefilled host-side (``batch.prefill_logs``); padding steps
+    # (ilen == 0) write nothing.
+    start = jnp.clip(op.ins_order_start.astype(I32), 0,
+                     doc.order_capacity - lmax)
+    k = jnp.arange(lmax, dtype=I32)
+    write = is_local & (k < ilen)
+    ol_chunk = lax.dynamic_slice(doc.ol_log, (start,), (lmax,))
+    or_chunk = lax.dynamic_slice(doc.or_log, (start,), (lmax,))
+    ol_log = lax.dynamic_update_slice(
+        doc.ol_log,
+        jnp.where(write & (k == 0), origin_left, ol_chunk), (start,))
+    or_log = lax.dynamic_update_slice(
+        doc.or_log, jnp.where(write, origin_right, or_chunk), (start,))
+
     return FlatDoc(
-        order=jnp.where(in_new, new_order, take(doc.order)),
-        origin_left=jnp.where(
-            in_new, jnp.where(k == 0, origin_left, new_order - 1),
-            take(doc.origin_left)),
-        origin_right=jnp.where(in_new, origin_right, take(doc.origin_right)),
-        rank=jnp.where(in_new, op.rank, take(doc.rank)),
-        chars=jnp.where(
-            in_new, op.chars[jnp.clip(k, 0, op.chars.shape[-1] - 1)],
-            take(doc.chars)),
-        deleted=jnp.where(in_new, False, take(deleted)),
+        signed=signed,
+        ol_log=ol_log,
+        or_log=or_log,
+        rank_log=doc.rank_log,
+        chars_log=doc.chars_log,
         n=doc.n + ilen,
         next_order=doc.next_order + op.order_advance,
     )
 
 
 def _check_capacity(doc: FlatDoc, ops: OpTensors) -> None:
-    """Host-side overflow guard: the splice clips silently on device, so
-    exceeding the static capacity would corrupt, not crash."""
+    """Host-side overflow guard: the splice wraps around silently on
+    device, so exceeding the static capacities would corrupt, not crash."""
     import numpy as np
 
     need = np.asarray(doc.n).max() + np.asarray(ops.ins_len).sum(axis=0).max()
@@ -173,20 +242,30 @@ def _check_capacity(doc: FlatDoc, ops: OpTensors) -> None:
         f"op stream needs {int(need)} rows but capacity is {doc.capacity}; "
         f"allocate a larger FlatDoc"
     )
+    o_need = (np.asarray(doc.next_order).max()
+              + np.asarray(ops.order_advance).sum(axis=0).max())
+    # lmax slots of headroom: the log-write window is a static lmax-wide
+    # slice whose clipped start must never shift a real write.
+    assert o_need <= doc.order_capacity - ops.lmax, (
+        f"op stream needs {int(o_need)}+{ops.lmax} orders but order "
+        f"capacity is {doc.order_capacity}; allocate a larger FlatDoc"
+    )
 
 
-@jax.jit
-def _apply_ops(doc: FlatDoc, ops: OpTensors) -> FlatDoc:
+@partial(jax.jit, static_argnames=("local_only",))
+def _apply_ops(doc: FlatDoc, ops: OpTensors, local_only: bool = False
+               ) -> FlatDoc:
     def body(d, op):
-        return step(d, op), None
+        return step(d, op, local_only=local_only), None
 
     out, _ = lax.scan(body, doc, ops)
     return out
 
 
-@jax.jit
-def _apply_ops_batch(docs: FlatDoc, ops: OpTensors) -> FlatDoc:
-    vstep = jax.vmap(step)
+@partial(jax.jit, static_argnames=("local_only",))
+def _apply_ops_batch(docs: FlatDoc, ops: OpTensors, local_only: bool = False
+                     ) -> FlatDoc:
+    vstep = jax.vmap(partial(step, local_only=local_only))
 
     def body(d, op):
         return vstep(d, op), None
@@ -195,15 +274,34 @@ def _apply_ops_batch(docs: FlatDoc, ops: OpTensors) -> FlatDoc:
     return out
 
 
-def apply_ops(doc: FlatDoc, ops: OpTensors) -> FlatDoc:
-    """Apply a compiled step stream to one document (``lax.scan``)."""
+def _is_local_only(ops: OpTensors) -> bool:
+    import numpy as np
+
+    return bool(np.all(np.asarray(ops.kind) == KIND_LOCAL))
+
+
+def apply_ops(doc: FlatDoc, ops: OpTensors, prefill: bool = True) -> FlatDoc:
+    """Apply a compiled step stream to one document (``lax.scan``).
+
+    ``prefill`` runs ``batch.prefill_logs`` first (host-side); pass False
+    when the doc's logs were already prefilled (e.g. re-running a stream).
+    """
+    from .batch import prefill_logs
+
     _check_capacity(doc, ops)
-    return _apply_ops(doc, ops)
+    if prefill:
+        doc = prefill_logs(doc, ops)
+    return _apply_ops(doc, ops, local_only=_is_local_only(ops))
 
 
-def apply_ops_batch(docs: FlatDoc, ops: OpTensors) -> FlatDoc:
+def apply_ops_batch(docs: FlatDoc, ops: OpTensors,
+                    prefill: bool = True) -> FlatDoc:
     """Batched apply: ``docs`` has a leading doc axis, ``ops`` is time-major
     [S, B, ...] (see ``batch.stack_ops``/``tile_ops``). The vmap'd step is
     the north-star "one pass across thousands of docs" kernel shape."""
+    from .batch import prefill_logs
+
     _check_capacity(docs, ops)
-    return _apply_ops_batch(docs, ops)
+    if prefill:
+        docs = prefill_logs(docs, ops)
+    return _apply_ops_batch(docs, ops, local_only=_is_local_only(ops))

@@ -1,31 +1,33 @@
-"""Flattened device state: the document body as struct-of-arrays columns.
+"""Flattened device state: the document body as TPU-friendly columns.
 
 This is the TPU-native replacement for the reference's pointer B-tree of RLE
-``YjsSpan`` runs (`src/range_tree/`, `src/list/span.rs:6-119`): one row per
-*item* (character), in document order, tombstones in place. The reference's
-per-span implicit origin chain (`span.rs:9-18`, `origin_left_at_offset`
-`span.rs:24-28`) is materialized per item, so every split/append origin
-fix-up (`span.rs:33-45,68-85`) becomes plain index arithmetic, and the
-cursor total order (`cursor.rs:274-304`) collapses to integer comparison.
+``YjsSpan`` runs (`src/range_tree/`, `src/list/span.rs:6-119`). Two ideas:
 
-Columns (all capacity-padded to a static shape for XLA):
+1. **One mutable per-position column.** Document order lives in ``signed``:
+   position ``i`` holds ``±(order+1)`` — magnitude is the item's dense op id
+   (`list/mod.rs:29-30`), sign is the tombstone (the reference's signed span
+   len, `span.rs:20,110-119`), ``0`` marks an empty slot. Every structural
+   edit (splice, tombstone flip) touches only this one i32 column, so the
+   apply kernel is pure elementwise/roll work — no TPU-hostile gathers.
 
-- ``order``        u32  dense op id of the item (`list/mod.rs:29-30`)
-- ``origin_left``  u32  per-item origin (chained within runs)
-- ``origin_right`` u32  shared across a run (`span.rs:15-18`)
-- ``rank``         u32  author agent's *name rank* — the device stand-in for
-                        the Yjs tiebreak on agent name (`doc.rs:206-209`);
-                        see ``batch.AgentTable``
-- ``chars``        u32  unicode codepoint (the reference drops text content
-                        with ``USE_INNER_ROPE=false``, `doc.rs:14-17`; we
-                        keep it so ``to_string`` works — column can be fed
-                        zeros when benchmarking for parity)
-- ``deleted``      bool tombstone flag — the sign bit of the reference's
-                        signed span len (`span.rs:110-119`)
+2. **By-order append-only logs.** Everything immutable per item is keyed by
+   its order, not its position: ``ol_log``/``or_log`` (origins),
+   ``rank_log`` (author name rank for the Yjs tiebreak, `doc.rs:206-209`),
+   ``chars_log`` (codepoints; the reference drops content with
+   ``USE_INNER_ROPE=false``, `doc.rs:14-17` — we keep it so ``to_string``
+   works). Orders are dense and assigned up front by the op compiler, so
+   the compiler *prefills* all log values it knows (chars, ranks, remote
+   origins, the within-run implicit origin chain `span.rs:9-13,24-28`);
+   the device writes only the two origins a local insert discovers at apply
+   time. Position→content is a host-side ``chars_log[order]`` gather at
+   readback.
 
-plus scalars ``n`` (live+tombstone rows) and ``next_order`` (next dense op
-id, `doc.rs:55-58` analog). Batched documents stack a leading axis on every
-field (vmap; sharded over the mesh's ``dp`` axis by ``parallel.mesh``).
+The per-span origin fix-ups on split/append (`span.rs:33-45,68-85`) are
+index arithmetic on these columns, and the cursor total order
+(`cursor.rs:274-304`) is integer comparison of positions.
+
+Batched documents stack a leading axis on every field (vmap; sharded over
+the mesh's ``dp`` axis by ``parallel.mesh``).
 """
 from __future__ import annotations
 
@@ -46,7 +48,7 @@ I32 = jnp.int32
 @partial(
     jax.tree_util.register_dataclass,
     data_fields=[
-        "order", "origin_left", "origin_right", "rank", "chars", "deleted",
+        "signed", "ol_log", "or_log", "rank_log", "chars_log",
         "n", "next_order",
     ],
     meta_fields=[],
@@ -55,31 +57,39 @@ I32 = jnp.int32
 class FlatDoc:
     """One (or a batch of) flattened CRDT document bodies."""
 
-    order: jax.Array        # u32[..., N]
-    origin_left: jax.Array  # u32[..., N]
-    origin_right: jax.Array  # u32[..., N]
-    rank: jax.Array         # u32[..., N]
-    chars: jax.Array        # u32[..., N]
-    deleted: jax.Array      # bool[..., N]
-    n: jax.Array            # i32[...]
-    next_order: jax.Array   # u32[...]
+    signed: jax.Array      # i32[..., CAP]   ±(order+1) in doc order; 0=empty
+    ol_log: jax.Array      # u32[..., OCAP]  origin_left by order
+    or_log: jax.Array      # u32[..., OCAP]  origin_right by order
+    rank_log: jax.Array    # u32[..., OCAP]  author name rank by order
+    chars_log: jax.Array   # u32[..., OCAP]  codepoint by order
+    n: jax.Array           # i32[...]        occupied rows (live+tombstone)
+    next_order: jax.Array  # u32[...]        next dense op id (`doc.rs:55-58`)
 
     @property
     def capacity(self) -> int:
-        return self.order.shape[-1]
+        return self.signed.shape[-1]
+
+    @property
+    def order_capacity(self) -> int:
+        return self.ol_log.shape[-1]
 
 
-def make_flat_doc(capacity: int) -> FlatDoc:
+def make_flat_doc(capacity: int, order_capacity: int | None = None) -> FlatDoc:
     """Empty document (`doc.rs:51-64` analog — frontier/logs live host-side,
-    SURVEY §7 'Frontier/DAG logic is branchy — keep on host')."""
-    full = jnp.full(capacity, ROOT_ORDER, dtype=U32)
+    SURVEY §7 'Frontier/DAG logic is branchy — keep on host').
+
+    ``order_capacity`` bounds total orders consumed (inserts AND deletes
+    take order ids, `doc.rs:155-165`); defaults to ``2 * capacity``.
+    """
+    if order_capacity is None:
+        order_capacity = 2 * capacity
+    zeros_o = jnp.zeros(order_capacity, dtype=U32)
     return FlatDoc(
-        order=full,
-        origin_left=full,
-        origin_right=full,
-        rank=jnp.zeros(capacity, dtype=U32),
-        chars=jnp.zeros(capacity, dtype=U32),
-        deleted=jnp.zeros(capacity, dtype=jnp.bool_),
+        signed=jnp.zeros(capacity, dtype=I32),
+        ol_log=jnp.full(order_capacity, ROOT_ORDER, dtype=U32),
+        or_log=jnp.full(order_capacity, ROOT_ORDER, dtype=U32),
+        rank_log=zeros_o,
+        chars_log=zeros_o,
         n=jnp.asarray(0, dtype=I32),
         next_order=jnp.asarray(0, dtype=U32),
     )
@@ -96,19 +106,22 @@ def stack_docs(doc: FlatDoc, batch: int) -> FlatDoc:
 
 
 def download(doc: FlatDoc) -> dict:
-    """Device -> host: numpy columns truncated to the live row count.
+    """Device -> host: per-item numpy columns in document order.
 
-    The downloaded arrays *are* the wire format (SURVEY §2 `Rle` row: flat
-    sorted span arrays upload/download as-is).
+    Materializes the by-order logs back into positional columns (the
+    downloaded arrays are the RLE wire format, SURVEY §2 `Rle` row).
     """
     n = int(doc.n)
+    signed = np.asarray(doc.signed[:n]).astype(np.int64)
+    order = (np.abs(signed) - 1).astype(np.uint32)
+    deleted = signed < 0
     return {
-        "order": np.asarray(doc.order[:n]),
-        "origin_left": np.asarray(doc.origin_left[:n]),
-        "origin_right": np.asarray(doc.origin_right[:n]),
-        "rank": np.asarray(doc.rank[:n]),
-        "chars": np.asarray(doc.chars[:n]),
-        "deleted": np.asarray(doc.deleted[:n]),
+        "order": order,
+        "origin_left": np.asarray(doc.ol_log)[order],
+        "origin_right": np.asarray(doc.or_log)[order],
+        "rank": np.asarray(doc.rank_log)[order],
+        "chars": np.asarray(doc.chars_log)[order],
+        "deleted": deleted,
         "next_order": int(doc.next_order),
     }
 
@@ -133,18 +146,32 @@ def doc_spans(doc: FlatDoc) -> List[Tuple[int, int, int, int]]:
     )
 
 
-def upload_oracle(oracle, capacity: int, rank_of_agent: np.ndarray) -> FlatDoc:
+def upload_oracle(
+    oracle,
+    capacity: int,
+    rank_of_agent: np.ndarray,
+    order_capacity: int | None = None,
+) -> FlatDoc:
     """Host oracle document -> device state (resume/warm-start path).
 
     ``rank_of_agent`` maps the oracle's dense agent ids to name ranks (see
     ``batch.AgentTable``).
     """
+    if order_capacity is None:
+        order_capacity = 2 * capacity
     n = oracle.n
+    next_order = oracle.get_next_order()
     assert n <= capacity, f"doc ({n} rows) exceeds device capacity {capacity}"
+    assert next_order <= order_capacity, (
+        f"doc ({next_order} orders) exceeds order capacity {order_capacity}")
 
-    def pad_u32(a, fill):
-        out = np.full(capacity, fill, dtype=np.uint32)
-        out[:n] = a[:n]
+    order = oracle.order[:n].astype(np.int64)
+    signed = np.zeros(capacity, dtype=np.int32)
+    signed[:n] = np.where(oracle.deleted[:n], -(order + 1), order + 1)
+
+    def log_from(items, fill):
+        out = np.full(order_capacity, fill, dtype=np.uint32)
+        out[order] = items[:n]
         return jnp.asarray(out)
 
     # Per-item author rank: one vectorized searchsorted of item orders
@@ -153,21 +180,15 @@ def upload_oracle(oracle, capacity: int, rank_of_agent: np.ndarray) -> FlatDoc:
         [e.order for e in oracle.client_with_order], dtype=np.int64)
     run_agents = np.asarray(
         [e.agent for e in oracle.client_with_order], dtype=np.int64)
-    run_idx = np.searchsorted(
-        run_starts, oracle.order[:n].astype(np.int64), side="right") - 1
+    run_idx = np.searchsorted(run_starts, order, side="right") - 1
     ranks = np.asarray(rank_of_agent)[run_agents[run_idx]].astype(np.uint32)
+
     return FlatDoc(
-        order=pad_u32(oracle.order, ROOT_ORDER),
-        origin_left=pad_u32(oracle.origin_left, ROOT_ORDER),
-        origin_right=pad_u32(oracle.origin_right, ROOT_ORDER),
-        rank=pad_u32(ranks, 0),
-        chars=pad_u32(oracle.chars, 0),
-        deleted=jnp.asarray(
-            np.concatenate([
-                oracle.deleted[:n],
-                np.zeros(capacity - n, dtype=bool),
-            ])
-        ),
+        signed=jnp.asarray(signed),
+        ol_log=log_from(oracle.origin_left, ROOT_ORDER),
+        or_log=log_from(oracle.origin_right, ROOT_ORDER),
+        rank_log=log_from(ranks, 0),
+        chars_log=log_from(oracle.chars, 0),
         n=jnp.asarray(n, dtype=I32),
-        next_order=jnp.asarray(oracle.get_next_order(), dtype=U32),
+        next_order=jnp.asarray(next_order, dtype=U32),
     )

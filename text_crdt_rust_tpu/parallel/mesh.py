@@ -64,8 +64,8 @@ def doc_pspecs(batched: bool = True) -> FlatDoc:
     else:
         col, scalar = P("sp"), P()
     return FlatDoc(
-        order=col, origin_left=col, origin_right=col, rank=col,
-        chars=col, deleted=col, n=scalar, next_order=scalar,
+        signed=col, ol_log=col, or_log=col, rank_log=col,
+        chars_log=col, n=scalar, next_order=scalar,
     )
 
 
