@@ -43,13 +43,64 @@ def expected_content(patches) -> str:
     return s
 
 
+def bench_blocked(args, ops, patches, n_ops, capacity) -> None:
+    """One-kernel blocked replay (``ops.blocked``): docs ride the lane
+    dimension (batch is in units of 128 lanes). Timed over several runs —
+    device round-trip latency on the tunneled chip (~70ms) would otherwise
+    swamp the kernel."""
+    from text_crdt_rust_tpu.ops import blocked as BL
+
+    batch = max(128, (args.batch // 128) * 128)
+    # Headroom: rebalance degrades as fill -> K-lmax; 2x keeps fill <= K/2.
+    cap = capacity * 2
+    block_k = min(args.block_k, cap // 2)  # small prefixes: >= 2 blocks
+    log(f"blocked engine: batch {batch} (128-lane units), capacity {cap}, "
+        f"block_k {block_k}")
+    run = BL.make_replayer(
+        ops, capacity=cap, batch=batch,
+        block_k=block_k, chunk=args.chunk)
+
+    log("compiling...")
+    t0 = time.perf_counter()
+    res = run()
+    res.check()  # forces completion
+    log(f"first run (incl. compile): {time.perf_counter() - t0:.2f}s")
+
+    reps = 5
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        res = run()
+    res.check()
+    wall = (time.perf_counter() - t0) / reps
+
+    want = expected_content(patches)
+    doc = BL.blocked_to_flat(ops, res)
+    got = SA.to_string(doc)
+    assert got == want, "blocked replay diverged from string oracle"
+
+    total_ops = n_ops * batch
+    ops_per_sec = total_ops / wall
+    log(f"wall {wall:.3f}s/run (avg of {reps}), {total_ops} ops -> "
+        f"{ops_per_sec:,.0f} ops/s")
+    print(json.dumps({
+        "metric": "crdt_ops_per_sec_chip",
+        "value": round(ops_per_sec, 1),
+        "unit": "ops/s",
+        "vs_baseline": round(ops_per_sec / CPU_BASELINE_OPS_PER_SEC, 3),
+    }))
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--trace", default="automerge-paper")
-    ap.add_argument("--patches", type=int, default=2000,
+    ap.add_argument("--patches", type=int, default=30000,
                     help="trace prefix length (full trace: 0)")
-    ap.add_argument("--batch", type=int, default=4096)
+    ap.add_argument("--batch", type=int, default=128)
     ap.add_argument("--lmax", type=int, default=16)
+    ap.add_argument("--engine", choices=("flat", "blocked"),
+                    default="blocked")
+    ap.add_argument("--block-k", type=int, default=256)
+    ap.add_argument("--chunk", type=int, default=1024)
     args = ap.parse_args()
 
     dev = jax.devices()[0]
@@ -62,10 +113,14 @@ def main() -> None:
     n_ops = len(patches)
     ins_total = sum(len(p.ins_content) for p in patches)
     capacity = 1 << int(np.ceil(np.log2(max(ins_total, 64))))
-    ops, _ = B.compile_local_patches(patches, lmax=args.lmax)
+    dmax = args.lmax if args.engine == "blocked" else None
+    ops, _ = B.compile_local_patches(patches, lmax=args.lmax, dmax=dmax)
     steps = ops.num_steps
     log(f"{args.trace}[:{n_ops}] -> {steps} device steps, "
         f"capacity {capacity}, batch {args.batch}")
+
+    if args.engine == "blocked":
+        return bench_blocked(args, ops, patches, n_ops, capacity)
 
     # Identical docs share one op stream: vmap with in_axes=None keeps the
     # uploaded stream at [S, ...] (no host-side tiling, ~MBs not GBs). The

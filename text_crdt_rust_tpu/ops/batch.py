@@ -228,28 +228,43 @@ def compile_local_patches(
     rank: int = 0,
     lmax: int = 16,
     start_order: int = 0,
+    dmax: Optional[int] = None,
 ) -> Tuple[OpTensors, int]:
     """Single-author local edit stream -> op tensors.
 
     Returns ``(ops, next_order)``. Each patch deletes then inserts at
     ``pos`` (`doc.rs:392-464` op order: delete ops take the earlier order
-    numbers, then the insert run).
+    numbers, then the insert run). ``dmax`` additionally chunks deletes
+    (the blocked engine bounds per-step delete spans; the flat engine's
+    live-rank window op handles any span, so None = unchunked).
     """
+    assert dmax is None or dmax >= 1, f"dmax must be >= 1, got {dmax}"
     rows = _Rows(lmax)
     next_order = start_order
     for p in patches:
         ins = p.ins_content
-        # First step carries the whole delete (the live-rank window op
-        # handles any span in one pass) + the first insert chunk.
         first_chunk = ins[:lmax]
+        dfirst = p.del_len if dmax is None else min(p.del_len, dmax)
+        # First step: (a chunk of) the delete + the first insert chunk.
         rows.emit(
-            kind=KIND_LOCAL, pos=p.pos, del_len=p.del_len,
+            kind=KIND_LOCAL, pos=p.pos, del_len=dfirst,
             ins_len=len(first_chunk),
             ins_order_start=next_order + p.del_len,
-            order_advance=p.del_len + len(first_chunk),
+            order_advance=dfirst + len(first_chunk),
             rank=rank, content=first_chunk,
         )
         next_order += p.del_len + len(first_chunk)
+        # Remaining delete chunks run after the first insert chunk landed
+        # at pos, so the chars still to delete now sit after it: target
+        # pos + len(first_chunk).
+        doff = dfirst
+        while doff < p.del_len:
+            chunk_len = min(p.del_len - doff, dmax)
+            rows.emit(
+                kind=KIND_LOCAL, pos=p.pos + len(first_chunk),
+                del_len=chunk_len, order_advance=chunk_len, rank=rank,
+            )
+            doff += chunk_len
         off = len(first_chunk)
         while off < len(ins):
             chunk = ins[off:off + lmax]
@@ -335,9 +350,9 @@ def compile_remote_txns(
 # -- log prefill -------------------------------------------------------------
 
 
-def _prefill_one(ol, orr, rank, chars, ops: OpTensors) -> None:
-    """Scatter one unbatched op stream's compile-time-known log values
-    (in place, numpy). See ``prefill_logs``."""
+def _prefill_scatter(ops: OpTensors):
+    """The compile-time-known log writes of one unbatched op stream, as
+    (positions, values) pairs. See ``prefill_logs``."""
     ins_len = np.asarray(ops.ins_len, dtype=np.int64)
     starts = np.asarray(ops.ins_order_start, dtype=np.int64)
     kinds = np.asarray(ops.kind)
@@ -348,7 +363,7 @@ def _prefill_one(ol, orr, rank, chars, ops: OpTensors) -> None:
 
     sel = ins_len > 0
     if not sel.any():
-        return
+        return None
     reps = ins_len[sel]
     total = int(reps.sum())
     step_idx = np.repeat(np.nonzero(sel)[0], reps)
@@ -356,17 +371,31 @@ def _prefill_one(ol, orr, rank, chars, ops: OpTensors) -> None:
         np.cumsum(reps) - reps, reps)
     pos = starts[sel].repeat(reps) + within
 
-    chars[pos] = op_chars[step_idx, within]
-    rank[pos] = ranks[step_idx]
     # Within-run implicit origin chain (`span.rs:9-13,24-28`): item k's
     # origin_left is order+k-1. The run head's origins are known at compile
     # time only for remote inserts; local heads are written on device.
     chain = within > 0
-    ol[pos[chain]] = (pos[chain] - 1).astype(np.uint32)
     remote = kinds[step_idx] == KIND_REMOTE_INS
     head = ~chain & remote
-    ol[pos[head]] = ol_ops[step_idx[head]]
-    orr[pos[remote]] = or_ops[step_idx[remote]]
+    return {
+        "chars": (pos, op_chars[step_idx, within]),
+        "rank": (pos, ranks[step_idx]),
+        "ol": (np.concatenate([pos[chain], pos[head]]),
+               np.concatenate([(pos[chain] - 1).astype(np.uint32),
+                               ol_ops[step_idx[head]]])),
+        "or": (pos[remote], or_ops[step_idx[remote]]),
+    }
+
+
+def _apply_scatter(ol, orr, rank, chars, sc) -> None:
+    """Apply a scatter to 1-D ``[OCAP]`` or 2-D ``[B, OCAP]`` logs (the
+    trailing-axis fancy index broadcasts over the doc axis)."""
+    if sc is None:
+        return
+    chars[..., sc["chars"][0]] = sc["chars"][1]
+    rank[..., sc["rank"][0]] = sc["rank"][1]
+    ol[..., sc["ol"][0]] = sc["ol"][1]
+    orr[..., sc["or"][0]] = sc["or"][1]
 
 
 def prefill_logs(doc, ops: OpTensors):
@@ -378,9 +407,9 @@ def prefill_logs(doc, ops: OpTensors):
 
     ``ops`` may be unbatched ``[S, ...]`` (doc unbatched, or one stream
     shared by every doc of a batched doc) or batched ``[S, B, ...]`` (doc
-    batched ``[B, ...]``). For identical fresh docs, prefilling before
-    ``stack_docs`` is cheaper (one pass, broadcast after).
-    Returns a new doc; host-side numpy work.
+    batched ``[B, ...]``). Tiled batches (every doc's column identical,
+    the ``tile_ops`` output) are detected and prefilled with one scatter
+    broadcast across the doc axis. Returns a new doc; host-side numpy.
     """
     import jax.numpy as jnp
 
@@ -391,12 +420,25 @@ def prefill_logs(doc, ops: OpTensors):
     chars = np.array(doc.chars_log)
     if ol.ndim == 1:
         assert not ops_batched, "batched ops need a batched doc"
-        _prefill_one(ol, orr, rank, chars, ops)
+        _apply_scatter(ol, orr, rank, chars, _prefill_scatter(ops))
+    elif not ops_batched:
+        _apply_scatter(ol, orr, rank, chars, _prefill_scatter(ops))
     else:
-        for b in range(ol.shape[0]):
-            per_doc = (jax.tree.map(lambda a: np.asarray(a)[:, b], ops)
-                       if ops_batched else ops)
-            _prefill_one(ol[b], orr[b], rank[b], chars[b], per_doc)
+        def tiled(a):
+            a = np.asarray(a)
+            return bool((a == a[:, :1] if a.ndim == 2
+                         else a == a[:, :1, ...]).all())
+
+        if all(tiled(np.asarray(c)) for c in
+               (ops.kind, ops.ins_len, ops.ins_order_start, ops.rank,
+                ops.origin_left, ops.origin_right, ops.chars)):
+            one = jax.tree.map(lambda a: np.asarray(a)[:, 0], ops)
+            _apply_scatter(ol, orr, rank, chars, _prefill_scatter(one))
+        else:
+            for b in range(ol.shape[0]):
+                per_doc = jax.tree.map(lambda a: np.asarray(a)[:, b], ops)
+                _apply_scatter(ol[b], orr[b], rank[b], chars[b],
+                               _prefill_scatter(per_doc))
     return dataclasses.replace(
         doc, ol_log=jnp.asarray(ol), or_log=jnp.asarray(orr),
         rank_log=jnp.asarray(rank), chars_log=jnp.asarray(chars))

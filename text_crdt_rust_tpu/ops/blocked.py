@@ -1,0 +1,433 @@
+"""Blocked Pallas replay engine: the whole edit stream in ONE kernel.
+
+The flat engine (``ops.flat``) pays two costs per op: it touches the full
+capacity and — dominating in practice — it dispatches ~20 XLA kernels per
+scanned step (~100us of fixed overhead on the bench chip). This engine is
+the TPU-native answer to the reference's B-tree (`src/range_tree/`): one
+``pallas_call`` applies the *entire* compiled local-edit stream, holding the
+document in VMEM as fixed-size blocks:
+
+- state is ``signed`` rows (same ±(order+1) encoding as ``span_arrays``)
+  laid out as ``NB`` blocks of ``K`` rows, occupied rows packed at each
+  block's front — the VMEM analog of B-tree leaves (`mod.rs:36-39`);
+- per-block live counts replace the internal nodes' subtree sums
+  (`mod.rs:85-93`): position→block is a cumsum+compare over ``NB`` scalars,
+  position→row a cumsum over one ``K``-row block — O(NB + K) per op
+  instead of O(capacity);
+- inserts splice one block with static power-of-two rolls (the
+  ``ops.flat`` shift trick) — block b's packed slack absorbs them, the
+  analog of the reference's leaf-append fast path (`mutations.rs:57-109`);
+- deletes flip signs inside a 2-block window walked across the span
+  (`mutations.rs:520-570`);
+- a block overflow triggers a global *rebalance* — compact all packed rows
+  and redeal them evenly — replacing the B-tree's node-split bubbling
+  (`mutations.rs:623-808`) with an O(capacity) pass that amortizes to
+  nothing (a block absorbs K-fill inserts between rebalances);
+- documents batch in the LANE dimension: every vector op processes
+  ``batch`` docs at once, all replaying one shared op stream (the
+  `BASELINE.json` config-2 shape: N identical docs, `benches/yjs.rs:41-48`
+  run batched). Per-doc divergent streams stay on ``ops.flat``.
+
+Origins a local insert discovers (`doc.rs:447-453`) are emitted per step
+and merged into the by-order logs host-side, so the kernel's result
+converts to a full ``span_arrays.FlatDoc`` — bit-identical to the flat
+engine's.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..common import ROOT_ORDER
+from .batch import KIND_LOCAL, OpTensors, prefill_logs
+from .flat import _order_of
+from .span_arrays import FlatDoc, I32, U32, make_flat_doc
+
+
+def _lane_scalar(x2d) -> jax.Array:
+    """Row-sum then lane-max: collapse a lane-replicated [rows, B] value to
+    one scalar. Valid because every doc (lane) replays the same stream, so
+    all lanes hold identical control state."""
+    return jnp.max(jnp.sum(x2d, axis=0))
+
+
+def _cumsum_rows(x) -> jax.Array:
+    """Inclusive cumsum along the (sublane) row axis via log2 roll-adds."""
+    n = x.shape[0]
+    row = lax.broadcasted_iota(jnp.int32, x.shape, 0)
+    out = x
+    shift = 1
+    while shift < n:
+        out = out + jnp.where(row >= shift, pltpu.roll(out, shift, axis=0), 0)
+        shift *= 2
+    return out
+
+
+def _shift_rows(x, amount, max_amount: int) -> jax.Array:
+    """Rows shifted toward higher indices by dynamic ``amount``
+    (0..max_amount) — one static roll per bit (``flat._shift_right``)."""
+    out = x
+    for b in range(max(max_amount, 1).bit_length()):
+        out = jnp.where((amount >> b) & 1 != 0,
+                        pltpu.roll(out, 1 << b, axis=0), out)
+    return out
+
+
+def _replay_kernel(
+    pos_ref, dlen_ref, ilen_ref, start_ref,     # [CHUNK] SMEM op columns
+    ol_ref, or_ref,                             # [CHUNK,B] VMEM outputs
+    sig_out_ref, rows_out_ref, err_ref,         # final state outputs
+    sig, rws, liv, tmp,                         # VMEM scratch
+    *, K: int, NB: int, CHUNK: int, LMAX: int,
+):
+    B = sig.shape[1]
+    i = pl.program_id(0)
+    last = pl.num_programs(0) - 1
+    idx_nb = lax.broadcasted_iota(jnp.int32, rws.shape, 0)
+    idx_k = lax.broadcasted_iota(jnp.int32, (K, B), 0)
+    idx_2k = lax.broadcasted_iota(jnp.int32, (2 * K, B), 0)
+    root_u = jnp.uint32(ROOT_ORDER)
+
+    @pl.when(i == 0)
+    def _init():
+        # Cold start: empty document (warm start re-uploads via
+        # blocked_to_flat -> flat engine for now).
+        sig[:] = jnp.zeros_like(sig)
+        rws[:] = jnp.zeros_like(rws)
+        liv[:] = jnp.zeros_like(liv)
+        err_ref[:] = jnp.zeros_like(err_ref)
+
+    def live_before_block(b):
+        return _lane_scalar(jnp.where(idx_nb < b, liv[:], 0))
+
+    def block_of_rank(rank1):
+        """Smallest block whose cumulative live count reaches ``rank1``
+        (the B-tree descent `root.rs:54-88` over block sums)."""
+        cumlive = _cumsum_rows(jnp.where(idx_nb < NB, liv[:], 0))
+        hits = (cumlive < rank1) & (idx_nb < NB)
+        return jnp.max(jnp.sum(hits.astype(jnp.int32), axis=0))
+
+    def rebalance():
+        """Compact all packed rows, redeal evenly (`mutations.rs:623-808`
+        analog). O(cap); triggered only on block overflow."""
+        total = _lane_scalar(jnp.where(idx_nb < NB, rws[:], 0))
+        fill = (total + NB - 1) // NB
+
+        @pl.when(fill > K - LMAX)
+        def _overflow():
+            err_ref[0:1, :] = jnp.ones((1, B), jnp.int32)
+
+        def compact(j, off):
+            rows_j = _lane_scalar(jnp.where(idx_nb == j, rws[:], 0))
+            tmp[pl.ds(off, K), :] = sig[pl.ds(j * K, K), :]
+            return off + rows_j
+
+        lax.fori_loop(0, NB, compact, 0)
+
+        def deal(j, _):
+            rows_j = jnp.clip(total - j * fill, 0, fill)
+            blk = tmp[pl.ds(j * fill, K), :]
+            nblk = jnp.where(idx_k < rows_j, blk, 0)
+            sig[pl.ds(j * K, K), :] = nblk
+            rws[pl.ds(j, 1), :] = jnp.broadcast_to(rows_j, (1, B))
+            liv[pl.ds(j, 1), :] = jnp.sum(
+                (nblk > 0).astype(jnp.int32), axis=0, keepdims=True)
+            return 0
+
+        lax.fori_loop(0, NB, deal, 0)
+
+    def do_delete(p, d):
+        """Tombstone ``d`` live chars after content pos ``p``
+        (`mutations.rs:520-570`); walks 2-block windows across the span."""
+
+        def body(carry):
+            rem, iters = carry
+            b = jnp.minimum(block_of_rank(p + 1), NB - 2)
+            base = live_before_block(b)
+            win = sig[pl.ds(b * K, 2 * K), :]
+            wlive = win > 0
+            rank = base + _cumsum_rows(wlive.astype(jnp.int32))
+            flip = wlive & (rank > p) & (rank <= p + rem)
+            sig[pl.ds(b * K, 2 * K), :] = jnp.where(flip, -win, win)
+            fcounts = flip.astype(jnp.int32)
+            f0 = _lane_scalar(jnp.where(idx_2k < K, fcounts, 0))
+            f1 = _lane_scalar(jnp.where(idx_2k >= K, fcounts, 0))
+            liv[pl.ds(b, 1), :] = liv[pl.ds(b, 1), :] - f0
+            liv[pl.ds(b + 1, 1), :] = liv[pl.ds(b + 1, 1), :] - f1
+            return rem - f0 - f1, iters + 1
+
+        # Iteration bound: each window contains >= 1 target char for a
+        # valid stream, so NB+1 windows means the delete ran off the
+        # document (invalid op) — flag instead of hanging the chip.
+        rem, iters = lax.while_loop(
+            lambda c: (c[0] > 0) & (c[1] <= NB), body, (d, 0))
+
+        @pl.when(rem > 0)
+        def _bad_delete():
+            err_ref[1:2, :] = jnp.ones((1, B), jnp.int32)
+
+    def do_insert(k, p, il, st):
+        """Splice ``il`` new items after live rank ``p`` into one block
+        (`mutations.rs:17-179`; packed slack instead of node splits)."""
+
+        def target():
+            b = jnp.where(p == 0, 0, block_of_rank(p))
+            r0 = _lane_scalar(jnp.where(idx_nb == b, rws[:], 0))
+            return b, r0
+
+        b, r0 = target()
+
+        @pl.when(r0 + il > K)
+        def _rb():
+            rebalance()
+
+        b, r0 = target()
+        local_rank = p - live_before_block(b)
+        blk = sig[pl.ds(b * K, K), :]
+        bcum = _cumsum_rows((blk > 0).astype(jnp.int32))
+        c0 = jnp.max(jnp.sum(
+            (bcum < local_rank).astype(jnp.int32), axis=0))
+        c = jnp.where(p == 0, 0, c0 + 1)
+
+        # Origins (`doc.rs:447-453`): left = predecessor item; right = raw
+        # successor without skipping tombstones (`doc.rs:452-453`) — the
+        # pre-splice row c, or the first packed row of the next non-empty
+        # block when c is past this block's rows.
+        left_signed = _lane_scalar(jnp.where(idx_k == c - 1, blk, 0))
+        left = jnp.where(p == 0, root_u, _order_of(left_signed))
+        succ_here = _lane_scalar(jnp.where(idx_k == c, blk, 0))
+        nb_next = jnp.max(jnp.min(jnp.where(
+            (idx_nb > b) & (idx_nb < NB) & (rws[:] > 0), idx_nb, NB),
+            axis=0))
+        nxt = sig[pl.ds(jnp.minimum(nb_next, NB - 1) * K, K), :]
+        succ_next = _lane_scalar(jnp.where(idx_k == 0, nxt, 0))
+        succ_signed = jnp.where(c < r0, succ_here,
+                                jnp.where(nb_next < NB, succ_next, 0))
+        right = jnp.where(succ_signed == 0, root_u, _order_of(succ_signed))
+
+        shifted = _shift_rows(blk, il, LMAX)
+        new_vals = st + (idx_k - c) + 1
+        nblk = jnp.where(idx_k < c, blk,
+                         jnp.where(idx_k < c + il, new_vals, shifted))
+        sig[pl.ds(b * K, K), :] = nblk
+        rws[pl.ds(b, 1), :] = rws[pl.ds(b, 1), :] + il
+        liv[pl.ds(b, 1), :] = liv[pl.ds(b, 1), :] + il
+
+        ol_ref[pl.ds(k, 1), :] = jnp.broadcast_to(left, (1, B))
+        or_ref[pl.ds(k, 1), :] = jnp.broadcast_to(right, (1, B))
+
+    def op_body(k, _):
+        p = pos_ref[k]
+        d = dlen_ref[k]
+        il = ilen_ref[k]
+        st = start_ref[k]
+
+        @pl.when(d > 0)
+        def _():
+            do_delete(p, d)
+
+        @pl.when(il > 0)
+        def _():
+            do_insert(k, p, il, st)
+
+        return 0
+
+    lax.fori_loop(0, CHUNK, op_body, 0)
+
+    @pl.when(i == last)
+    def _flush():
+        sig_out_ref[:] = sig[:]
+        rows_out_ref[:] = rws[:]
+
+
+@dataclasses.dataclass
+class BlockedResult:
+    """Device outputs of one ``replay_local`` call.
+
+    Everything stays on device until read; call ``check()`` (or convert
+    via ``blocked_to_flat``, which checks) to surface kernel error flags —
+    the device↔host round-trip is ~100ms on a tunneled chip, so the
+    kernel never syncs eagerly.
+    """
+
+    signed: jax.Array   # i32[CAP, B] blocked rows (packed per block)
+    rows: jax.Array     # i32[NBp, B] occupied rows per block
+    ol: jax.Array       # u32[S, B]  per-step local origin_left
+    orr: jax.Array      # u32[S, B]  per-step local origin_right
+    err: jax.Array      # i32[8, B]  row 0: capacity exhausted; row 1: bad delete
+    block_k: int
+    num_blocks: int
+    batch: int
+
+    def check(self) -> None:
+        err = np.asarray(self.err)
+        assert err[0].max() == 0, (
+            "blocked engine capacity exhausted (rebalance found fill > "
+            "K-lmax); raise capacity")
+        assert err[1].max() == 0, (
+            "delete ran past the end of the document (invalid op stream)")
+
+
+def make_replayer(
+    ops: OpTensors,
+    capacity: int,
+    batch: int = 128,
+    block_k: int = 256,
+    chunk: int = 1024,
+    interpret: bool = False,
+):
+    """Stage ``ops`` on device and build a reusable jitted replayer.
+
+    Returns a zero-argument callable producing a ``BlockedResult``; the
+    op upload and the pallas trace/compile happen once, so repeated calls
+    pay only kernel execution (bench steady state).
+    """
+    kinds = np.asarray(ops.kind)
+    assert kinds.ndim == 1, "blocked engine takes one shared stream"
+    assert (kinds == KIND_LOCAL).all(), (
+        "blocked engine replays local streams; remote ops -> ops.flat")
+    assert capacity % block_k == 0
+    # Rank-1 i32 arrays tile at T(1024) on TPU; the SMEM op blocks must
+    # match that layout (smaller streams fall back to one whole-array
+    # block via s_pad == chunk).
+    assert chunk % 1024 == 0 or not jax.default_backend() == "tpu", (
+        "chunk must be a multiple of 1024 on TPU")
+    NB = capacity // block_k
+    assert NB >= 2, "need at least two blocks (delete window)"
+    NBp = max(8, NB)
+    lmax = ops.lmax
+    assert block_k > lmax, (
+        f"block_k ({block_k}) must exceed the insert chunk width "
+        f"({lmax}); a full block could never absorb an insert")
+    rows_needed = int(np.asarray(ops.ins_len, dtype=np.int64).sum())
+    rows_limit = NB * (block_k - lmax)
+    assert rows_needed <= rows_limit, (
+        f"stream inserts {rows_needed} rows but {NB} blocks of "
+        f"{block_k} hold at most {rows_limit} at the rebalance fill "
+        f"limit (K-lmax); raise capacity")
+
+    s = ops.num_steps
+    s_pad = max(((s + chunk - 1) // chunk) * chunk, chunk)
+    pad = ((0, s_pad - s),)
+
+    def padded(a):
+        return jnp.asarray(np.pad(np.asarray(a, dtype=np.int32), pad))
+
+    staged = (padded(ops.pos), padded(ops.del_len), padded(ops.ins_len),
+              padded(ops.ins_order_start))
+
+    smem = lambda: pl.BlockSpec(
+        (chunk,), lambda i: (i,), memory_space=pltpu.SMEM)
+
+    def whole(shape):
+        return pl.BlockSpec(shape, lambda i: tuple(0 for _ in shape),
+                            memory_space=pltpu.VMEM)
+
+    call = pl.pallas_call(
+        partial(_replay_kernel, K=block_k, NB=NB, CHUNK=chunk, LMAX=lmax),
+        grid=(s_pad // chunk,),
+        in_specs=[smem(), smem(), smem(), smem()],
+        out_specs=[
+            pl.BlockSpec((chunk, batch), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((chunk, batch), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            whole((capacity, batch)),
+            whole((NBp, batch)),
+            whole((8, batch)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((s_pad, batch), jnp.uint32),
+            jax.ShapeDtypeStruct((s_pad, batch), jnp.uint32),
+            jax.ShapeDtypeStruct((capacity, batch), jnp.int32),
+            jax.ShapeDtypeStruct((NBp, batch), jnp.int32),
+            jax.ShapeDtypeStruct((8, batch), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((capacity, batch), jnp.int32),
+            pltpu.VMEM((NBp, batch), jnp.int32),
+            pltpu.VMEM((NBp, batch), jnp.int32),
+            pltpu.VMEM((capacity + block_k, batch), jnp.int32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            # The default 16MB scoped-vmem cap rejects big documents; the
+            # chip has 128MB of VMEM.
+            vmem_limit_bytes=100 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )
+    jitted = jax.jit(lambda a, b, c, d: call(a, b, c, d))
+
+    def run() -> BlockedResult:
+        ol, orr, signed, rows, err = jitted(*staged)
+        return BlockedResult(
+            signed=signed, rows=rows, ol=ol[:s], orr=orr[:s], err=err,
+            block_k=block_k, num_blocks=NB, batch=batch)
+
+    return run
+
+
+def replay_local(
+    ops: OpTensors,
+    capacity: int,
+    batch: int = 128,
+    block_k: int = 256,
+    chunk: int = 1024,
+    interpret: bool = False,
+) -> BlockedResult:
+    """One-shot convenience wrapper over ``make_replayer``."""
+    return make_replayer(ops, capacity, batch=batch, block_k=block_k,
+                         chunk=chunk, interpret=interpret)()
+
+
+def blocked_to_flat(
+    ops: OpTensors,
+    res: BlockedResult,
+    capacity: int | None = None,
+    order_capacity: int | None = None,
+    doc_index: int = 0,
+) -> FlatDoc:
+    """Kernel result -> a standard ``FlatDoc`` (one doc of the batch):
+    concatenate each block's packed rows, prefill the by-order logs, then
+    merge the kernel's per-step local origins."""
+    res.check()
+    sig = np.asarray(res.signed)[:, doc_index]
+    r = np.asarray(res.rows)[:, doc_index]
+    K, NB = res.block_k, res.num_blocks
+    parts = [sig[b * K: b * K + r[b]] for b in range(NB)]
+    flat = np.concatenate(parts) if parts else np.zeros(0, np.int32)
+    n = len(flat)
+
+    if capacity is None:
+        capacity = max(res.signed.shape[0], n)
+    doc = make_flat_doc(capacity, order_capacity)
+    doc = prefill_logs(doc, ops)
+    ol_log = np.array(doc.ol_log)
+    or_log = np.array(doc.or_log)
+    starts = np.asarray(ops.ins_order_start, dtype=np.int64)
+    ilens = np.asarray(ops.ins_len, dtype=np.int64)
+    ol_np = np.asarray(res.ol)[:, doc_index]
+    or_np = np.asarray(res.orr)[:, doc_index]
+    for st, il, left, right in zip(starts, ilens, ol_np, or_np):
+        if il > 0:
+            ol_log[st] = left
+            or_log[st: st + il] = right
+
+    signed_col = np.zeros(capacity, np.int32)
+    signed_col[:n] = flat
+    advance = int(np.asarray(ops.order_advance, dtype=np.int64).sum())
+    return dataclasses.replace(
+        doc,
+        signed=jnp.asarray(signed_col),
+        ol_log=jnp.asarray(ol_log),
+        or_log=jnp.asarray(or_log),
+        n=jnp.asarray(n, I32),
+        next_order=jnp.asarray(advance, U32),
+    )
