@@ -1,0 +1,128 @@
+"""Checkpoint/resume round-trips and metrics sanity."""
+import random
+
+import numpy as np
+import pytest
+
+from text_crdt_rust_tpu.models import ListCRDT
+from text_crdt_rust_tpu.models.sync import export_txns_since, merge_into
+from text_crdt_rust_tpu.ops import batch as B
+from text_crdt_rust_tpu.ops import flat as F
+from text_crdt_rust_tpu.ops import span_arrays as SA
+from text_crdt_rust_tpu.utils.checkpoint import (
+    load_doc,
+    load_flat_doc,
+    save_doc,
+    save_flat_doc,
+)
+from text_crdt_rust_tpu.utils.metrics import (
+    Throughput,
+    doc_stats,
+    memory_stats,
+)
+from text_crdt_rust_tpu.utils.testdata import TestPatch
+
+from test_device_flat import oracle_from_patches, random_patches
+
+
+def two_peer_doc(seed=3):
+    rng = random.Random(seed)
+    pa, _ = random_patches(rng, 60)
+    pb, _ = random_patches(rng, 60)
+    a = oracle_from_patches(pa, agent="peer-a")
+    b = oracle_from_patches(pb, agent="peer-b")
+    merge_into(a, b)
+    return a
+
+
+class TestOracleCheckpoint:
+    def test_roundtrip_bit_identical(self, tmp_path):
+        doc = two_peer_doc()
+        p = str(tmp_path / "doc.npz")
+        save_doc(doc, p)
+        back = load_doc(p)
+        back.check()
+        assert back.to_string() == doc.to_string()
+        assert back.doc_spans() == doc.doc_spans()
+        assert back.frontier == doc.frontier
+        assert list(back.deletes) == list(doc.deletes)
+        assert list(back.double_deletes) == list(doc.double_deletes)
+        assert list(back.txns) == list(doc.txns)
+        assert list(back.client_with_order) == list(doc.client_with_order)
+        assert [cd.name for cd in back.client_data] == [
+            cd.name for cd in doc.client_data]
+
+    def test_resume_keeps_editing_and_merging(self, tmp_path):
+        # A restored doc must keep full CRDT function: local edits, export,
+        # merge — the logs are the state (SURVEY §5).
+        doc = two_peer_doc()
+        p = str(tmp_path / "doc.npz")
+        save_doc(doc, p)
+        back = load_doc(p)
+
+        a = back.get_or_create_agent_id("peer-a")
+        back.local_insert(a, 0, "resumed:")
+        other = ListCRDT()
+        for t in export_txns_since(back, 0):
+            other.apply_remote_txn(t)
+        assert other.to_string() == back.to_string()
+        assert other.to_string().startswith("resumed:")
+
+    def test_device_warm_start_from_checkpoint(self, tmp_path):
+        doc = two_peer_doc()
+        p = str(tmp_path / "doc.npz")
+        save_doc(doc, p)
+        back = load_doc(p)
+        table = B.AgentTable([cd.name for cd in back.client_data])
+        flat = SA.upload_oracle(back, 1024, table.rank_of_agent())
+        assert SA.to_string(flat) == doc.to_string()
+        assert SA.doc_spans(flat) == doc.doc_spans()
+
+
+class TestFlatDocCheckpoint:
+    def test_roundtrip_and_resume_on_device(self, tmp_path):
+        rng = random.Random(17)
+        patches, content = random_patches(rng, 60)
+        ops, next_order = B.compile_local_patches(patches, lmax=4)
+        doc = F.apply_ops(SA.make_flat_doc(512), ops)
+        p = str(tmp_path / "flat.npz")
+        save_flat_doc(doc, p)
+        back = load_flat_doc(p)
+        assert SA.to_string(back) == content
+        assert SA.doc_spans(back) == SA.doc_spans(doc)
+        # Resume editing on device from the restored state.
+        more, _ = B.compile_local_patches(
+            [TestPatch(0, 0, "hi ")], start_order=next_order)
+        out = F.apply_ops(back, more)
+        assert SA.to_string(out) == "hi " + content
+
+
+class TestMetrics:
+    def test_doc_stats_oracle_vs_flat_agree(self):
+        rng = random.Random(5)
+        patches, _ = random_patches(rng, 80)
+        oracle = oracle_from_patches(patches)
+        ops, _ = B.compile_local_patches(patches, lmax=4)
+        flat = F.apply_ops(SA.make_flat_doc(1024), ops)
+        so, sf = doc_stats(oracle), doc_stats(flat)
+        for k in ("items", "live", "tombstones", "merged_spans"):
+            assert so[k] == sf[k], k
+        assert so["compaction"] == pytest.approx(sf["compaction"])
+        hist = so["span_histogram"]
+        assert sum(hist.values()) == so["merged_spans"]
+
+    def test_memory_stats(self):
+        doc = two_peer_doc()
+        m = memory_stats(doc)
+        assert m["total_bytes"] == sum(m["columns"].values())
+        assert m["efficient_bytes"] == 16 * doc_stats(doc)["merged_spans"]
+
+    def test_throughput_meter(self):
+        meter = Throughput()
+        with meter.measure(ops=100):
+            pass
+        meter.add(900, 0.1)
+        s = meter.summary()
+        assert s["ops"] == 1000
+        assert s["samples"] == 2
+        assert meter.ops_per_sec > 0

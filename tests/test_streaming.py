@@ -1,0 +1,97 @@
+"""Streaming apply: the device engine fed incrementally, N-peer convergence.
+
+`BASELINE.json` config 5's shape: txns arrive over time (possibly out of
+order), are released by the causal buffer, compiled in batches, and applied
+to a persistent device document across multiple ``apply_ops`` calls — with
+the host oracle tracking the same stream for equality.
+"""
+import random
+
+from text_crdt_rust_tpu.models import ListCRDT
+from text_crdt_rust_tpu.models.sync import export_txns_since
+from text_crdt_rust_tpu.ops import batch as B
+from text_crdt_rust_tpu.ops import flat as F
+from text_crdt_rust_tpu.ops import span_arrays as SA
+from text_crdt_rust_tpu.parallel import CausalBuffer
+
+from test_device_flat import (
+    assert_same_doc,
+    oracle_from_patches,
+    random_patches,
+)
+
+
+def test_streaming_local_chunks_match_one_shot():
+    # One edit stream compiled and applied in 3 chunks must equal the
+    # single-shot replay (orders continue across calls).
+    rng = random.Random(31)
+    patches, content = random_patches(rng, 90)
+    oracle = oracle_from_patches(patches)
+
+    doc = SA.make_flat_doc(1024)
+    start = 0
+    for lo in range(0, 90, 30):
+        ops, start = B.compile_local_patches(
+            patches[lo:lo + 30], lmax=4, start_order=start)
+        doc = F.apply_ops(doc, ops)
+    assert_same_doc(doc, oracle)
+    assert SA.to_string(doc) == content
+
+
+def test_n_peer_shuffled_stream_device_convergence():
+    # 3 peers edit independently; their txns arrive shuffled, pass through
+    # the causal buffer, and are applied in released order to BOTH the
+    # oracle and the device engine in batches of 4.
+    rng = random.Random(47)
+    peers = ["amy", "bob", "cat"]
+    txns = []
+    for name in peers:
+        patches, _ = random_patches(rng, 40)
+        txns.extend(export_txns_since(
+            oracle_from_patches(patches, agent=name), 0))
+    rng.shuffle(txns)
+
+    buf = CausalBuffer()
+    released = []
+    for t in txns:
+        released.extend(buf.add(t))
+    assert buf.pending == 0
+
+    oracle = ListCRDT()
+    for t in released:
+        oracle.apply_remote_txn(t)
+
+    table = B.AgentTable(peers)
+    assigner = None
+    doc = SA.make_flat_doc(2048)
+    for lo in range(0, len(released), 4):
+        ops, assigner = B.compile_remote_txns(
+            released[lo:lo + 4], table, assigner=assigner, lmax=4)
+        doc = F.apply_ops(doc, ops)
+    assert_same_doc(doc, oracle)
+
+
+def test_peer_pair_cross_sync_device_matches_oracle():
+    # Two peers sync through each other's exports mid-edit; the final
+    # oracle history replayed onto the device engine matches.
+    rng = random.Random(53)
+    a = ListCRDT()
+    b = ListCRDT()
+    ia = a.get_or_create_agent_id("amy")
+    ib = b.get_or_create_agent_id("bob")
+    from text_crdt_rust_tpu.models.sync import merge_into
+
+    a.local_insert(ia, 0, "hello ")
+    merge_into(b, a)
+    b.local_insert(ib, 6, "world")
+    a.local_delete(ia, 0, 1)
+    merge_into(a, b)
+    merge_into(b, a)
+    assert a.to_string() == b.to_string()
+
+    txns = export_txns_since(a, 0)
+    table = B.AgentTable(["amy", "bob"])
+    ops, _ = B.compile_remote_txns(txns, table, lmax=4)
+    doc = F.apply_ops(SA.make_flat_doc(256), ops)
+    assert SA.to_string(doc) == a.to_string()
+    assert SA.doc_spans(doc) == a.doc_spans()

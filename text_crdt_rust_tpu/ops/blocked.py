@@ -297,7 +297,8 @@ def make_replayer(
     # Rank-1 i32 arrays tile at T(1024) on TPU; the SMEM op blocks must
     # match that layout (smaller streams fall back to one whole-array
     # block via s_pad == chunk).
-    assert chunk % 1024 == 0 or not jax.default_backend() == "tpu", (
+    assert interpret or chunk % 1024 == 0 or (
+        jax.default_backend() != "tpu"), (
         "chunk must be a multiple of 1024 on TPU")
     NB = capacity // block_k
     assert NB >= 2, "need at least two blocks (delete window)"

@@ -1,3 +1,5 @@
+from .checkpoint import load_doc, load_flat_doc, save_doc, save_flat_doc
+from .metrics import Throughput, doc_stats, memory_stats, print_stats
 from .rle import (
     KCRDTSpan,
     KDeleteEntry,
@@ -22,4 +24,12 @@ __all__ = [
     "TestTxn",
     "load_testing_data",
     "trace_path",
+    "load_doc",
+    "load_flat_doc",
+    "save_doc",
+    "save_flat_doc",
+    "Throughput",
+    "doc_stats",
+    "memory_stats",
+    "print_stats",
 ]

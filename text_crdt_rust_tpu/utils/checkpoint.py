@@ -1,0 +1,166 @@
+"""Checkpoint / resume: the document state as flat numpy arrays.
+
+The reference implements no persistence, but its state is fully determined
+by the RLE logs (SURVEY §5 "Checkpoint/resume": client_with_order +
+item_orders + deletes + txns determine the document; the range tree is a
+cache of their materialization). This module makes that concrete:
+
+- a checkpoint is one ``.npz`` of flat columns — the same arrays that are
+  the host↔device wire format (SURVEY §2 `Rle` row), so saving a document
+  costs a ``np.savez`` and no re-encoding;
+- agent names ride in a JSON header (names are the only strings — numeric
+  ids are peer-local, `README.md:33-35`);
+- resume rebuilds a ``models.oracle.ListCRDT`` bit-identically (asserted
+  by tests via doc_spans/frontier/log equality), and the device engines
+  warm-start from it via ``span_arrays.upload_oracle``.
+
+``save_flat_doc``/``load_flat_doc`` checkpoint a device ``FlatDoc``
+directly (download once, upload on load) for the streaming-apply path
+(`BASELINE.json` config 5's periodic host↔TPU resync).
+"""
+from __future__ import annotations
+
+import json
+from typing import List
+
+import numpy as np
+
+from ..common import ROOT_ORDER
+from .rle import (
+    KCRDTSpan,
+    KDeleteEntry,
+    KDoubleDelete,
+    KOrderSpan,
+    Rle,
+    TxnSpan,
+)
+
+FORMAT_VERSION = 1
+
+
+def _meta_to_array(meta: dict) -> np.ndarray:
+    return np.frombuffer(json.dumps(meta).encode("utf-8"), dtype=np.uint8)
+
+
+def _meta_from_array(arr: np.ndarray) -> dict:
+    return json.loads(arr.tobytes().decode("utf-8"))
+
+
+def save_doc(doc, path: str) -> None:
+    """Serialize an oracle ``ListCRDT`` to ``path`` (.npz)."""
+    n = doc.n
+    cwo = list(doc.client_with_order)
+    deletes = list(doc.deletes)
+    dds = list(doc.double_deletes)
+    txns = list(doc.txns)
+    item_orders = [
+        (a, e.seq, e.order, e.length)
+        for a, cd in enumerate(doc.client_data)
+        for e in cd.item_orders
+    ]
+    parents = [
+        (i, p) for i, t in enumerate(txns) for p in t.parents
+    ]
+    meta = {
+        "version": FORMAT_VERSION,
+        "agents": [cd.name for cd in doc.client_data],
+        "n": n,
+    }
+    np.savez(
+        path,
+        meta=_meta_to_array(meta),
+        order=doc.order[:n],
+        origin_left=doc.origin_left[:n],
+        origin_right=doc.origin_right[:n],
+        deleted=doc.deleted[:n],
+        chars=doc.chars[:n],
+        frontier=np.asarray(doc.frontier, dtype=np.uint32),
+        cwo=np.asarray([(e.order, e.agent, e.seq, e.length) for e in cwo],
+                       dtype=np.int64).reshape(-1, 4),
+        item_orders=np.asarray(item_orders, dtype=np.int64).reshape(-1, 4),
+        deletes=np.asarray([(e.op_order, e.target, e.length)
+                            for e in deletes],
+                           dtype=np.int64).reshape(-1, 3),
+        double_deletes=np.asarray([(e.target, e.length, e.excess)
+                                   for e in dds],
+                                  dtype=np.int64).reshape(-1, 3),
+        txns=np.asarray([(t.order, t.length, t.shadow) for t in txns],
+                        dtype=np.int64).reshape(-1, 3),
+        txn_parents=np.asarray(parents, dtype=np.int64).reshape(-1, 2),
+    )
+
+
+def load_doc(path: str):
+    """Rebuild an oracle ``ListCRDT`` from a ``save_doc`` checkpoint."""
+    from ..models.oracle import ClientData, ListCRDT
+
+    z = np.load(path)
+    meta = _meta_from_array(z["meta"])
+    assert meta["version"] == FORMAT_VERSION, (
+        f"unknown checkpoint version {meta['version']}")
+    n = int(meta["n"])
+
+    doc = ListCRDT(capacity=max(n, 64))
+    doc.n = n
+    doc.order[:n] = z["order"]
+    doc.origin_left[:n] = z["origin_left"]
+    doc.origin_right[:n] = z["origin_right"]
+    doc.deleted[:n] = z["deleted"]
+    doc.chars[:n] = z["chars"]
+    doc.frontier = [int(o) for o in z["frontier"]]
+
+    doc.client_data = [ClientData(name) for name in meta["agents"]]
+    for a, seq, order, length in z["item_orders"]:
+        doc.client_data[int(a)].item_orders.append(
+            KOrderSpan(int(seq), int(order), int(length)))
+    for order, agent, seq, length in z["cwo"]:
+        doc.client_with_order.append(
+            KCRDTSpan(int(order), int(agent), int(seq), int(length)))
+    for op_order, target, length in z["deletes"]:
+        doc.deletes.append(
+            KDeleteEntry(int(op_order), int(target), int(length)))
+    for target, length, excess in z["double_deletes"]:
+        doc.double_deletes.append(
+            KDoubleDelete(int(target), int(length), int(excess)))
+    parents_by_txn: List[List[int]] = [[] for _ in range(len(z["txns"]))]
+    for i, p in z["txn_parents"]:
+        parents_by_txn[int(i)].append(int(p))
+    for (order, length, shadow), ps in zip(z["txns"], parents_by_txn):
+        doc.txns.append(TxnSpan(int(order), int(length), int(shadow), ps))
+    return doc
+
+
+def save_flat_doc(flat, path: str) -> None:
+    """Checkpoint a device ``FlatDoc`` (downloads once)."""
+    n = int(flat.n)
+    np.savez(
+        path,
+        meta=_meta_to_array({"version": FORMAT_VERSION, "kind": "flat"}),
+        signed=np.asarray(flat.signed),
+        ol_log=np.asarray(flat.ol_log),
+        or_log=np.asarray(flat.or_log),
+        rank_log=np.asarray(flat.rank_log),
+        chars_log=np.asarray(flat.chars_log),
+        n=np.asarray(n),
+        next_order=np.asarray(int(flat.next_order)),
+    )
+
+
+def load_flat_doc(path: str):
+    """Rebuild a device ``FlatDoc`` from a ``save_flat_doc`` checkpoint."""
+    import jax.numpy as jnp
+
+    from ..ops.span_arrays import FlatDoc, I32, U32
+
+    z = np.load(path)
+    meta = _meta_from_array(z["meta"])
+    assert meta.get("kind") == "flat", "not a FlatDoc checkpoint"
+    return FlatDoc(
+        signed=jnp.asarray(z["signed"]),
+        ol_log=jnp.asarray(z["ol_log"]),
+        or_log=jnp.asarray(z["or_log"]),
+        rank_log=jnp.asarray(z["rank_log"]),
+        chars_log=jnp.asarray(z["chars_log"]),
+        n=jnp.asarray(int(z["n"]), I32),
+        next_order=jnp.asarray(int(z["next_order"]), U32),
+    )

@@ -1,0 +1,165 @@
+"""Metrics & observability: the reference's ``print_stats`` family, TPU-ified.
+
+The reference instruments itself with a counting global allocator
+(`src/alloc.rs:13-50`) and per-container ``print_stats`` dumps — entry
+histograms, node counts, RLE compaction ratio ("compacts to N entries",
+`split_list/mod.rs:418`), actual-vs-efficient memory (`root.rs:293-326`).
+The TPU build's equivalents (SURVEY §5 "Tracing/profiling" row):
+
+- ``doc_stats``   — one dict per document: items/live/tombstones, merged
+                    span count + compaction ratio (the RLE health metric
+                    that decides device array sizes), span-length
+                    histogram, log entry counts;
+- ``memory_stats``— bytes per column for host oracle docs and device
+                    ``FlatDoc``s (device bytes ARE the HBM footprint);
+- ``Throughput``  — ops/sec accumulator for bench loops (wall-clock via
+                    ``time.perf_counter``, explicit ``ops`` counts).
+
+All functions accept either an oracle ``ListCRDT`` or a device ``FlatDoc``
+(anything exposing ``doc_spans``-compatible state).
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+
+def _spans_of(doc) -> List[Tuple[int, int, int, int]]:
+    if hasattr(doc, "doc_spans"):
+        return doc.doc_spans()
+    from ..ops.span_arrays import doc_spans
+    return doc_spans(doc)
+
+
+def _counts_of(doc, spans) -> Tuple[int, int]:
+    """(total items, live items) for oracle or FlatDoc. Derived from the
+    merged spans for device docs (avoids a second device->host download)."""
+    if hasattr(doc, "deleted"):  # oracle
+        n = doc.n
+        return n, int(np.count_nonzero(~doc.deleted[:n]))
+    lens = [s[3] for s in spans]
+    return sum(abs(l) for l in lens), sum(l for l in lens if l > 0)
+
+
+def span_histogram(spans, bins=(1, 2, 4, 8, 16, 32, 64, 128)) -> Dict[str, int]:
+    """Span-length histogram (the reference's entry-size histograms,
+    `root.rs:293-326`)."""
+    lens = np.asarray([abs(s[3]) for s in spans] or [0])
+    out: Dict[str, int] = {}
+    lo = 1
+    for hi in bins:
+        out[f"{lo}-{hi}"] = int(((lens >= lo) & (lens <= hi)).sum())
+        lo = hi + 1
+    out[f">{bins[-1]}"] = int((lens > bins[-1]).sum())
+    return out
+
+
+def doc_stats(doc, spans=None) -> dict:
+    """Document-health metrics; ``compaction`` is items per merged span —
+    the reference's "compacts to N entries" ratio. Pass precomputed
+    ``spans`` to avoid re-downloading a device doc."""
+    if spans is None:
+        spans = _spans_of(doc)
+    items, live = _counts_of(doc, spans)
+    stats = {
+        "items": items,
+        "live": live,
+        "tombstones": items - live,
+        "merged_spans": len(spans),
+        "compaction": items / max(1, len(spans)),
+        "span_histogram": span_histogram(spans),
+    }
+    if hasattr(doc, "deletes"):  # oracle-side logs
+        stats["deletes_entries"] = doc.deletes.num_entries()
+        stats["double_delete_entries"] = doc.double_deletes.num_entries()
+        stats["txn_entries"] = doc.txns.num_entries()
+    return stats
+
+
+def memory_stats(doc, spans=None) -> dict:
+    """Bytes per column. For a device ``FlatDoc`` these are the actual HBM
+    buffer sizes; ``efficient_bytes`` is what a fully RLE-compacted span
+    store would need (16B/span, `span.rs:126-129`) — the reference's
+    actual-vs-efficient comparison. Pass precomputed ``spans`` to avoid
+    re-downloading a device doc."""
+    if spans is None:
+        spans = _spans_of(doc)
+    if hasattr(doc, "deleted"):  # oracle numpy columns
+        cols = {k: getattr(doc, k).nbytes
+                for k in ("order", "origin_left", "origin_right",
+                          "deleted", "chars")}
+    else:
+        cols = {k: int(np.prod(getattr(doc, k).shape)
+                       * getattr(doc, k).dtype.itemsize)
+                for k in ("signed", "ol_log", "or_log", "rank_log",
+                          "chars_log")}
+    total = sum(cols.values())
+    return {
+        "columns": cols,
+        "total_bytes": total,
+        "efficient_bytes": 16 * len(spans),
+        "overhead": total / max(1, 16 * len(spans)),
+    }
+
+
+class Throughput:
+    """Ops/sec accumulator for bench loops.
+
+    >>> meter = Throughput()
+    >>> with meter.measure(ops=1000): ...   # doctest: +SKIP
+    >>> meter.ops_per_sec                   # doctest: +SKIP
+    """
+
+    def __init__(self) -> None:
+        self.ops = 0
+        self.seconds = 0.0
+        self.samples = 0
+
+    def add(self, ops: int, seconds: float) -> None:
+        self.ops += ops
+        self.seconds += seconds
+        self.samples += 1
+
+    def measure(self, ops: int):
+        meter = self
+
+        class _Ctx:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                meter.add(ops, time.perf_counter() - self.t0)
+                return False
+
+        return _Ctx()
+
+    @property
+    def ops_per_sec(self) -> float:
+        return self.ops / self.seconds if self.seconds else 0.0
+
+    def summary(self) -> dict:
+        return {"ops": self.ops, "seconds": round(self.seconds, 6),
+                "ops_per_sec": round(self.ops_per_sec, 1),
+                "samples": self.samples}
+
+
+def print_stats(doc, detailed: bool = False) -> None:
+    """Human-readable dump (`doc.rs:492-498` analog). Downloads a device
+    doc once and shares the spans across both stat passes."""
+    spans = _spans_of(doc)
+    d = doc_stats(doc, spans=spans)
+    m = memory_stats(doc, spans=spans)
+    print(f"doc: {d['items']} items ({d['live']} live, "
+          f"{d['tombstones']} tombstones), {d['merged_spans']} merged spans "
+          f"(compaction {d['compaction']:.1f}x)")
+    print(f"  memory: {m['total_bytes']:,} B actual vs "
+          f"{m['efficient_bytes']:,} B compacted "
+          f"({m['overhead']:.1f}x overhead)")
+    if detailed:
+        print(f"  span histogram: {d['span_histogram']}")
+        for k in ("deletes_entries", "double_delete_entries", "txn_entries"):
+            if k in d:
+                print(f"  {k}: {d[k]}")
