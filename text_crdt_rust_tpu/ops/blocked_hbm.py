@@ -1,0 +1,410 @@
+"""HBM-resident blocked replay: full-trace documents, DMA'd block windows.
+
+``ops.blocked`` holds the whole document in VMEM, which caps a 128-doc
+batch near ~50k rows. This engine keeps the blocked state in HBM and
+caches ONE two-block window in VMEM, exploiting edit locality (typing
+touches the same neighborhood for long runs — the same locality the
+reference's leaf-append fast paths exploit, `mutations.rs:57-109`):
+
+- per op, the target window [b, b+1) is ensured in the VMEM cache; a miss
+  costs two async DMA copies (write-back + fetch);
+- position→block uses a two-level live-count index: super-block sums
+  (one row per ``SUP`` blocks) narrow the search before a short in-segment
+  cumsum — the B-tree's internal levels (`mod.rs:85-93`) as two scans;
+- inserts splice within one cached block half; deletes walk cached
+  windows; both reuse the VMEM engine's roll/cumsum algebra;
+- block overflow triggers the global compact-and-redeal rebalance, done
+  as HBM→HBM DMA through a VMEM staging block (O(capacity) DMA traffic,
+  amortized over the K-fill inserts a fresh block absorbs).
+
+Same op surface, outputs, and FlatDoc conversion as ``ops.blocked``; the
+capacity is bounded by HBM (GBs), not VMEM, so the full automerge-paper
+trace (182k insertions) replays across a 128-doc lane batch in one kernel.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..common import ROOT_ORDER
+from .batch import KIND_LOCAL, OpTensors
+from .blocked import BlockedResult, _cumsum_rows, _lane_scalar, _shift_rows
+from .flat import _order_of
+
+SUP = 64  # blocks per super-block (level-2 index fan-out)
+
+
+def _hbm_replay_kernel(
+    pos_ref, dlen_ref, ilen_ref, start_ref,     # [CHUNK] SMEM op columns
+    ol_ref, or_ref,                             # [CHUNK,B] VMEM outputs
+    state_ref, tmp_ref,                         # [CAP(+K),B] ANY/HBM state
+    rows_out_ref, err_ref,                      # final outputs
+    win, stage, rws, liv, supliv, wmeta, sem,   # scratch
+    *, K: int, NB: int, NSUP: int, CHUNK: int, LMAX: int,
+):
+    B = win.shape[1]
+    i = pl.program_id(0)
+    last = pl.num_programs(0) - 1
+    idx_nb = lax.broadcasted_iota(jnp.int32, rws.shape, 0)
+    idx_sup = lax.broadcasted_iota(jnp.int32, supliv.shape, 0)
+    idx_k = lax.broadcasted_iota(jnp.int32, (K, B), 0)
+    idx_2k = lax.broadcasted_iota(jnp.int32, (2 * K, B), 0)
+    root_u = jnp.uint32(ROOT_ORDER)
+
+    def dma_out(cb):
+        cp = pltpu.make_async_copy(
+            win, state_ref.at[pl.ds(cb * K, 2 * K), :], sem)
+        cp.start()
+        cp.wait()
+
+    def dma_in(b):
+        cp = pltpu.make_async_copy(
+            state_ref.at[pl.ds(b * K, 2 * K), :], win, sem)
+        cp.start()
+        cp.wait()
+
+    def ensure(b):
+        """Make the VMEM cache hold window [b, b+1); b <= NB-2."""
+        cb = wmeta[0]
+
+        @pl.when(cb != b)
+        def _miss():
+            dma_out(cb)
+            dma_in(b)
+            wmeta[0] = b
+
+    @pl.when(i == 0)
+    def _init():
+        rws[:] = jnp.zeros_like(rws)
+        liv[:] = jnp.zeros_like(liv)
+        supliv[:] = jnp.zeros_like(supliv)
+        err_ref[:] = jnp.zeros_like(err_ref)
+        win[:] = jnp.zeros_like(win)
+
+        def zero_blk(j, _):
+            cp = pltpu.make_async_copy(
+                win, state_ref.at[pl.ds(j * 2 * K, 2 * K), :], sem)
+            cp.start()
+            cp.wait()
+            return 0
+
+        lax.fori_loop(0, NB // 2, zero_blk, 0)
+        wmeta[0] = 0  # cache holds (zeroed) window [0, 2)
+
+    def live_before_block(b):
+        """Live items in blocks [0, b): super-block prefix + in-segment
+        remainder (two short scans instead of one NB-long one)."""
+        s = b // SUP
+        sup_part = _lane_scalar(jnp.where(idx_sup < s, supliv[:], 0))
+        seg = liv[pl.ds(s * SUP, SUP), :]
+        seg_idx = lax.broadcasted_iota(jnp.int32, (SUP, B), 0)
+        seg_part = _lane_scalar(
+            jnp.where(seg_idx < (b - s * SUP), seg, 0))
+        return sup_part + seg_part
+
+    def block_of_rank(rank1):
+        """Smallest block whose cumulative live count reaches ``rank1``."""
+        supcum = _cumsum_rows(jnp.where(idx_sup < NSUP, supliv[:], 0))
+        s = jnp.minimum(
+            jnp.max(jnp.sum(
+                ((supcum < rank1) & (idx_sup < NSUP)).astype(jnp.int32),
+                axis=0)),
+            NSUP - 1)
+        base = _lane_scalar(jnp.where(idx_sup < s, supliv[:], 0))
+        seg = liv[pl.ds(s * SUP, SUP), :]
+        segcum = _cumsum_rows(seg)
+        within = jnp.max(jnp.sum(
+            (segcum < (rank1 - base)).astype(jnp.int32), axis=0))
+        return jnp.minimum(s * SUP + within, NB - 1)
+
+    def bump(b, dl, dr):
+        """Add dl to liv[b] (and the super-block), dr to rws[b]."""
+        liv[pl.ds(b, 1), :] = liv[pl.ds(b, 1), :] + dl
+        supliv[pl.ds(b // SUP, 1), :] = supliv[pl.ds(b // SUP, 1), :] + dl
+        rws[pl.ds(b, 1), :] = rws[pl.ds(b, 1), :] + dr
+
+    def rebalance():
+        """Global compact-and-redeal over HBM, staged through VMEM.
+        Invalidates the window cache (caller re-ensures)."""
+        dma_out(wmeta[0])  # write back before shuffling blocks
+
+        total = _lane_scalar(jnp.where(idx_nb < NB, rws[:], 0))
+        fill = (total + NB - 1) // NB
+
+        @pl.when(fill > K - LMAX)
+        def _overflow():
+            err_ref[0:1, :] = jnp.ones((1, B), jnp.int32)
+
+        def compact(j, off):
+            rows_j = _lane_scalar(jnp.where(idx_nb == j, rws[:], 0))
+            cp = pltpu.make_async_copy(
+                state_ref.at[pl.ds(j * K, K), :],
+                tmp_ref.at[pl.ds(off, K), :], sem)
+            cp.start()
+            cp.wait()
+            return off + rows_j
+
+        lax.fori_loop(0, NB, compact, 0)
+
+        def deal(j, _):
+            rows_j = jnp.clip(total - j * fill, 0, fill)
+            cp = pltpu.make_async_copy(
+                tmp_ref.at[pl.ds(j * fill, K), :], stage, sem)
+            cp.start()
+            cp.wait()
+            nblk = jnp.where(idx_k < rows_j, stage[:], 0)
+            stage[:] = nblk
+            cp = pltpu.make_async_copy(
+                stage, state_ref.at[pl.ds(j * K, K), :], sem)
+            cp.start()
+            cp.wait()
+            rws[pl.ds(j, 1), :] = jnp.broadcast_to(rows_j, (1, B))
+            liv[pl.ds(j, 1), :] = jnp.sum(
+                (nblk > 0).astype(jnp.int32), axis=0, keepdims=True)
+            return 0
+
+        lax.fori_loop(0, NB, deal, 0)
+
+        # Rebuild super-block sums and refetch the cached window.
+        def resup(s, _):
+            seg = liv[pl.ds(s * SUP, SUP), :]
+            supliv[pl.ds(s, 1), :] = jnp.sum(seg, axis=0, keepdims=True)
+            return 0
+
+        lax.fori_loop(0, NSUP, resup, 0)
+        dma_in(wmeta[0])
+
+    def do_delete(p, d):
+        """Tombstone ``d`` live chars after content pos ``p``; walks cached
+        2-block windows across the span."""
+
+        def body(carry):
+            rem, iters = carry
+            b = jnp.minimum(block_of_rank(p + 1), NB - 2)
+            ensure(b)
+            base = live_before_block(b)
+            w = win[:]
+            wlive = w > 0
+            rank = base + _cumsum_rows(wlive.astype(jnp.int32))
+            flip = wlive & (rank > p) & (rank <= p + rem)
+            win[:] = jnp.where(flip, -w, w)
+            fcounts = flip.astype(jnp.int32)
+            f0 = _lane_scalar(jnp.where(idx_2k < K, fcounts, 0))
+            f1 = _lane_scalar(jnp.where(idx_2k >= K, fcounts, 0))
+            bump(b, -f0, 0)
+            bump(b + 1, -f1, 0)
+            return rem - f0 - f1, iters + 1
+
+        rem, _ = lax.while_loop(
+            lambda c: (c[0] > 0) & (c[1] <= NB), body, (d, 0))
+
+        @pl.when(rem > 0)
+        def _bad_delete():
+            err_ref[1:2, :] = jnp.ones((1, B), jnp.int32)
+
+    def do_insert(k, p, il, st):
+        """Splice ``il`` new items after live rank ``p`` into the cached
+        window's target block half."""
+
+        def target():
+            b = jnp.where(p == 0, 0, block_of_rank(p))
+            r0 = _lane_scalar(jnp.where(idx_nb == b, rws[:], 0))
+            return b, r0
+
+        b, r0 = target()
+
+        @pl.when(r0 + il > K)
+        def _rb():
+            rebalance()
+
+        b, r0 = target()
+        wb = jnp.minimum(b, NB - 2)
+        ensure(wb)
+        half = b - wb  # 0 or 1
+        base = live_before_block(b)
+        local_rank = p - base
+        blk = win[pl.ds(half * K, K), :]
+        bcum = _cumsum_rows((blk > 0).astype(jnp.int32))
+        c0 = jnp.max(jnp.sum(
+            (bcum < local_rank).astype(jnp.int32), axis=0))
+        c = jnp.where(p == 0, 0, c0 + 1)
+
+        # Origins (`doc.rs:447-453`): successor may live beyond this
+        # block — first packed row of the next non-empty block, fetched
+        # through a 1-block DMA peek (rare: only at block-boundary
+        # inserts; result unused when c < r0).
+        left_signed = _lane_scalar(jnp.where(idx_k == c - 1, blk, 0))
+        left = jnp.where(p == 0, root_u, _order_of(left_signed))
+        succ_here = _lane_scalar(jnp.where(idx_k == c, blk, 0))
+        nb_next = jnp.max(jnp.min(jnp.where(
+            (idx_nb > b) & (idx_nb < NB) & (rws[:] > 0), idx_nb, NB),
+            axis=0))
+
+        def peek_next():
+            nxt = jnp.minimum(nb_next, NB - 1)
+            in_window = (nxt == wb) | (nxt == wb + 1)
+
+            def from_window():
+                h = nxt - wb
+                row = win[pl.ds(h * K, K), :]
+                return _lane_scalar(jnp.where(idx_k == 0, row, 0))
+
+            def from_hbm():
+                cp = pltpu.make_async_copy(
+                    state_ref.at[pl.ds(nxt * K, K), :], stage, sem)
+                cp.start()
+                cp.wait()
+                return _lane_scalar(jnp.where(idx_k == 0, stage[:], 0))
+
+            return lax.cond(in_window, from_window, from_hbm)
+
+        need_peek = (c >= r0) & (nb_next < NB)
+        succ_next = lax.cond(need_peek, peek_next, lambda: jnp.int32(0))
+        succ_signed = jnp.where(c < r0, succ_here, succ_next)
+        right = jnp.where(succ_signed == 0, root_u, _order_of(succ_signed))
+
+        shifted = _shift_rows(blk, il, LMAX)
+        new_vals = st + (idx_k - c) + 1
+        nblk = jnp.where(idx_k < c, blk,
+                         jnp.where(idx_k < c + il, new_vals, shifted))
+        win[pl.ds(half * K, K), :] = nblk
+        bump(b, il, il)
+
+        ol_ref[pl.ds(k, 1), :] = jnp.broadcast_to(left, (1, B))
+        or_ref[pl.ds(k, 1), :] = jnp.broadcast_to(right, (1, B))
+
+    def op_body(k, _):
+        p = pos_ref[k]
+        d = dlen_ref[k]
+        il = ilen_ref[k]
+        st = start_ref[k]
+
+        @pl.when(d > 0)
+        def _():
+            do_delete(p, d)
+
+        @pl.when(il > 0)
+        def _():
+            do_insert(k, p, il, st)
+
+        return 0
+
+    lax.fori_loop(0, CHUNK, op_body, 0)
+
+    @pl.when(i == last)
+    def _flush():
+        dma_out(wmeta[0])
+        rows_out_ref[:] = rws[:]
+
+
+def make_replayer_hbm(
+    ops: OpTensors,
+    capacity: int,
+    batch: int = 128,
+    block_k: int = 512,
+    chunk: int = 1024,
+    interpret: bool = False,
+):
+    """HBM-state variant of ``blocked.make_replayer`` (same contract)."""
+    kinds = np.asarray(ops.kind)
+    assert kinds.ndim == 1, "blocked engine takes one shared stream"
+    assert (kinds == KIND_LOCAL).all(), (
+        "blocked engine replays local streams; remote ops -> ops.flat")
+    assert capacity % block_k == 0
+    assert interpret or chunk % 1024 == 0 or (
+        jax.default_backend() != "tpu"), (
+        "chunk must be a multiple of 1024 on TPU")
+    NB = capacity // block_k
+    assert NB >= 2 and NB % 2 == 0, "need an even number of blocks >= 2"
+    NSUP = (NB + SUP - 1) // SUP
+    NBp = max(8, ((NB + 7) // 8) * 8)
+    NSUPp = max(8, ((NSUP + 7) // 8) * 8)
+    lmax = ops.lmax
+    assert block_k > lmax, (
+        f"block_k ({block_k}) must exceed the insert chunk width ({lmax})")
+    rows_needed = int(np.asarray(ops.ins_len, dtype=np.int64).sum())
+    rows_limit = NB * (block_k - lmax)
+    assert rows_needed <= rows_limit, (
+        f"stream inserts {rows_needed} rows but {NB} blocks of "
+        f"{block_k} hold at most {rows_limit} at the rebalance fill "
+        f"limit (K-lmax); raise capacity")
+
+    s = ops.num_steps
+    s_pad = max(((s + chunk - 1) // chunk) * chunk, chunk)
+    pad = ((0, s_pad - s),)
+
+    def padded(a):
+        return jnp.asarray(np.pad(np.asarray(a, dtype=np.int32), pad))
+
+    staged = (padded(ops.pos), padded(ops.del_len), padded(ops.ins_len),
+              padded(ops.ins_order_start))
+
+    smem = lambda: pl.BlockSpec(
+        (chunk,), lambda i: (i,), memory_space=pltpu.SMEM)
+
+    def whole_vmem(shape):
+        return pl.BlockSpec(shape, lambda i: tuple(0 for _ in shape),
+                            memory_space=pltpu.VMEM)
+
+    def whole_any(shape):
+        del shape  # un-blocked: the kernel DMAs slices manually
+        return pl.BlockSpec(memory_space=pltpu.ANY)
+
+    call = pl.pallas_call(
+        partial(_hbm_replay_kernel, K=block_k, NB=NB, NSUP=NSUP,
+                CHUNK=chunk, LMAX=lmax),
+        grid=(s_pad // chunk,),
+        in_specs=[smem(), smem(), smem(), smem()],
+        out_specs=[
+            pl.BlockSpec((chunk, batch), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((chunk, batch), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            whole_any((capacity, batch)),
+            whole_any((capacity + block_k, batch)),
+            whole_vmem((NBp, batch)),
+            whole_vmem((8, batch)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((s_pad, batch), jnp.uint32),
+            jax.ShapeDtypeStruct((s_pad, batch), jnp.uint32),
+            jax.ShapeDtypeStruct((capacity, batch), jnp.int32),
+            jax.ShapeDtypeStruct((capacity + block_k, batch), jnp.int32),
+            jax.ShapeDtypeStruct((NBp, batch), jnp.int32),
+            jax.ShapeDtypeStruct((8, batch), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2 * block_k, batch), jnp.int32),   # window cache
+            pltpu.VMEM((block_k, batch), jnp.int32),       # DMA staging
+            pltpu.VMEM((NBp, batch), jnp.int32),           # rows
+            pltpu.VMEM((NBp, batch), jnp.int32),           # live
+            pltpu.VMEM((NSUPp, batch), jnp.int32),         # super live
+            pltpu.SMEM((1,), jnp.int32),                   # cached window
+            pltpu.SemaphoreType.DMA(()),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )
+    jitted = jax.jit(lambda a, b, c, d: call(a, b, c, d))
+
+    def run() -> BlockedResult:
+        ol, orr, state, _tmp, rows, err = jitted(*staged)
+        return BlockedResult(
+            signed=state, rows=rows, ol=ol[:s], orr=orr[:s], err=err,
+            block_k=block_k, num_blocks=NB, batch=batch)
+
+    return run
+
+
+def replay_local_hbm(ops: OpTensors, capacity: int, **kw) -> BlockedResult:
+    """One-shot convenience wrapper over ``make_replayer_hbm``."""
+    return make_replayer_hbm(ops, capacity, **kw)()
