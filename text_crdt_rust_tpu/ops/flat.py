@@ -43,7 +43,11 @@ from ..common import ROOT_ORDER
 from .batch import KIND_LOCAL, KIND_REMOTE_DEL, KIND_REMOTE_INS, OpTensors
 from .span_arrays import FlatDoc, I32, U32
 
-_ROOT = jnp.uint32(ROOT_ORDER)
+# numpy (not jnp) scalar: a module-level jnp constant would initialize the
+# default backend at import time, before callers can force a platform.
+import numpy as np
+
+_ROOT = np.uint32(ROOT_ORDER)
 
 
 def _order_of(signed: jax.Array) -> jax.Array:
@@ -235,8 +239,6 @@ def step(doc: FlatDoc, op, local_only: bool = False) -> FlatDoc:
 def _check_capacity(doc: FlatDoc, ops: OpTensors) -> None:
     """Host-side overflow guard: the splice wraps around silently on
     device, so exceeding the static capacities would corrupt, not crash."""
-    import numpy as np
-
     need = np.asarray(doc.n).max() + np.asarray(ops.ins_len).sum(axis=0).max()
     assert need <= doc.capacity, (
         f"op stream needs {int(need)} rows but capacity is {doc.capacity}; "
@@ -275,8 +277,6 @@ def _apply_ops_batch(docs: FlatDoc, ops: OpTensors, local_only: bool = False
 
 
 def _is_local_only(ops: OpTensors) -> bool:
-    import numpy as np
-
     return bool(np.all(np.asarray(ops.kind) == KIND_LOCAL))
 
 
