@@ -63,6 +63,22 @@ class TestMesh:
             assert SA.to_string(a) == SA.to_string(b) == content
             assert SA.doc_spans(a) == SA.doc_spans(b)
 
+    def test_fresh_docs_without_manual_prefill(self):
+        # Regression (ADVICE r1): the sharded apply must prefill the
+        # by-order logs itself — a fresh make_flat_doc applied without
+        # prefilled logs returns NUL chars and wrong tiebreak ranks.
+        rng = random.Random(61)
+        patches, content = random_patches(rng, 30)
+        ops, _ = B.compile_local_patches(patches, lmax=4)
+        batch = 8
+        batched = B.tile_ops(ops, batch)
+        docs = SA.stack_docs(SA.make_flat_doc(256), batch)  # NOT prefilled
+
+        mesh = make_mesh(dp=4, sp=2)
+        apply_fn = make_sharded_apply(mesh, donate=False)
+        out = apply_fn(shard_docs(docs, mesh), shard_ops(batched, mesh))
+        assert SA.to_string(jax_tree_index(out, 0)) == content
+
     def test_seq_parallel_one_doc(self):
         # Long-context path: ONE document's item axis sharded over all 8
         # chips (SURVEY §5 long-context row).

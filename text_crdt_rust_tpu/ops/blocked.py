@@ -51,6 +51,13 @@ from .flat import _order_of
 from .span_arrays import FlatDoc, I32, U32, make_flat_doc
 
 
+def _require(cond: bool, msg: str) -> None:
+    """Config/capacity precheck that must fire even under ``python -O``
+    (a violated precondition corrupts device state silently, no crash)."""
+    if not cond:
+        raise ValueError(msg)
+
+
 def _lane_scalar(x2d) -> jax.Array:
     """Row-sum then lane-max: collapse a lane-replicated [rows, B] value to
     one scalar. Valid because every doc (lane) replays the same stream, so
@@ -94,6 +101,11 @@ def _replay_kernel(
     idx_k = lax.broadcasted_iota(jnp.int32, (K, B), 0)
     idx_2k = lax.broadcasted_iota(jnp.int32, (2 * K, B), 0)
     root_u = jnp.uint32(ROOT_ORDER)
+
+    # Each grid step owns a fresh [CHUNK, B] origin-output block; rows for
+    # steps with ins_len == 0 would otherwise be uninitialized VMEM garbage.
+    ol_ref[:] = jnp.zeros_like(ol_ref)
+    or_ref[:] = jnp.zeros_like(or_ref)
 
     @pl.when(i == 0)
     def _init():
@@ -267,12 +279,16 @@ class BlockedResult:
     batch: int
 
     def check(self) -> None:
+        # Explicit raises, not assert: these surface device error flags and
+        # must fire even under ``python -O``.
         err = np.asarray(self.err)
-        assert err[0].max() == 0, (
-            "blocked engine capacity exhausted (rebalance found fill > "
-            "K-lmax); raise capacity")
-        assert err[1].max() == 0, (
-            "delete ran past the end of the document (invalid op stream)")
+        if err[0].max() != 0:
+            raise RuntimeError(
+                "blocked engine capacity exhausted (rebalance found fill > "
+                "K-lmax); raise capacity")
+        if err[1].max() != 0:
+            raise RuntimeError(
+                "delete ran past the end of the document (invalid op stream)")
 
 
 def make_replayer(
@@ -290,29 +306,31 @@ def make_replayer(
     pay only kernel execution (bench steady state).
     """
     kinds = np.asarray(ops.kind)
-    assert kinds.ndim == 1, "blocked engine takes one shared stream"
-    assert (kinds == KIND_LOCAL).all(), (
-        "blocked engine replays local streams; remote ops -> ops.flat")
-    assert capacity % block_k == 0
+    _require(kinds.ndim == 1, "blocked engine takes one shared stream")
+    _require(bool((kinds == KIND_LOCAL).all()),
+             "blocked engine replays local streams; remote ops -> ops.flat")
+    _require(capacity % block_k == 0,
+             f"capacity ({capacity}) must be a multiple of block_k "
+             f"({block_k})")
     # Rank-1 i32 arrays tile at T(1024) on TPU; the SMEM op blocks must
     # match that layout (smaller streams fall back to one whole-array
     # block via s_pad == chunk).
-    assert interpret or chunk % 1024 == 0 or (
-        jax.default_backend() != "tpu"), (
+    _require(interpret or chunk % 1024 == 0 or (
+        jax.default_backend() != "tpu"),
         "chunk must be a multiple of 1024 on TPU")
     NB = capacity // block_k
-    assert NB >= 2, "need at least two blocks (delete window)"
+    _require(NB >= 2, "need at least two blocks (delete window)")
     NBp = max(8, NB)
     lmax = ops.lmax
-    assert block_k > lmax, (
+    _require(block_k > lmax, (
         f"block_k ({block_k}) must exceed the insert chunk width "
-        f"({lmax}); a full block could never absorb an insert")
+        f"({lmax}); a full block could never absorb an insert"))
     rows_needed = int(np.asarray(ops.ins_len, dtype=np.int64).sum())
     rows_limit = NB * (block_k - lmax)
-    assert rows_needed <= rows_limit, (
+    _require(rows_needed <= rows_limit, (
         f"stream inserts {rows_needed} rows but {NB} blocks of "
         f"{block_k} hold at most {rows_limit} at the rebalance fill "
-        f"limit (K-lmax); raise capacity")
+        f"limit (K-lmax); raise capacity"))
 
     s = ops.num_steps
     s_pad = max(((s + chunk - 1) // chunk) * chunk, chunk)

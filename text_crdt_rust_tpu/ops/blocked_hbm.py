@@ -35,7 +35,13 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..common import ROOT_ORDER
 from .batch import KIND_LOCAL, OpTensors
-from .blocked import BlockedResult, _cumsum_rows, _lane_scalar, _shift_rows
+from .blocked import (
+    BlockedResult,
+    _cumsum_rows,
+    _lane_scalar,
+    _require,
+    _shift_rows,
+)
 from .flat import _order_of
 
 SUP = 64  # blocks per super-block (level-2 index fan-out)
@@ -79,6 +85,10 @@ def _hbm_replay_kernel(
             dma_out(cb)
             dma_in(b)
             wmeta[0] = b
+
+    # Fresh origin-output block per grid step; zero rows with ins_len == 0.
+    ol_ref[:] = jnp.zeros_like(ol_ref)
+    or_ref[:] = jnp.zeros_like(or_ref)
 
     @pl.when(i == 0)
     def _init():
@@ -314,27 +324,33 @@ def make_replayer_hbm(
 ):
     """HBM-state variant of ``blocked.make_replayer`` (same contract)."""
     kinds = np.asarray(ops.kind)
-    assert kinds.ndim == 1, "blocked engine takes one shared stream"
-    assert (kinds == KIND_LOCAL).all(), (
-        "blocked engine replays local streams; remote ops -> ops.flat")
-    assert capacity % block_k == 0
-    assert interpret or chunk % 1024 == 0 or (
-        jax.default_backend() != "tpu"), (
+    _require(kinds.ndim == 1, "blocked engine takes one shared stream")
+    _require(bool((kinds == KIND_LOCAL).all()),
+             "blocked engine replays local streams; remote ops -> ops.flat")
+    _require(capacity % block_k == 0,
+             f"capacity ({capacity}) must be a multiple of block_k "
+             f"({block_k})")
+    _require(interpret or chunk % 1024 == 0 or (
+        jax.default_backend() != "tpu"),
         "chunk must be a multiple of 1024 on TPU")
     NB = capacity // block_k
-    assert NB >= 2 and NB % 2 == 0, "need an even number of blocks >= 2"
+    _require(NB >= 2 and NB % 2 == 0, "need an even number of blocks >= 2")
     NSUP = (NB + SUP - 1) // SUP
-    NBp = max(8, ((NB + 7) // 8) * 8)
+    # liv is sliced in SUP-row segments (live_before_block / block_of_rank),
+    # so it must be padded to a whole number of super-blocks: NSUP * SUP.
+    # Anything smaller crashes (NB < SUP) or silently mis-slices the last
+    # partial super-block once content reaches it.
+    NBp = NSUP * SUP
     NSUPp = max(8, ((NSUP + 7) // 8) * 8)
     lmax = ops.lmax
-    assert block_k > lmax, (
-        f"block_k ({block_k}) must exceed the insert chunk width ({lmax})")
+    _require(block_k > lmax, (
+        f"block_k ({block_k}) must exceed the insert chunk width ({lmax})"))
     rows_needed = int(np.asarray(ops.ins_len, dtype=np.int64).sum())
     rows_limit = NB * (block_k - lmax)
-    assert rows_needed <= rows_limit, (
+    _require(rows_needed <= rows_limit, (
         f"stream inserts {rows_needed} rows but {NB} blocks of "
         f"{block_k} hold at most {rows_limit} at the rebalance fill "
-        f"limit (K-lmax); raise capacity")
+        f"limit (K-lmax); raise capacity"))
 
     s = ops.num_steps
     s_pad = max(((s + chunk - 1) // chunk) * chunk, chunk)
@@ -355,7 +371,7 @@ def make_replayer_hbm(
 
     def whole_any(shape):
         del shape  # un-blocked: the kernel DMAs slices manually
-        return pl.BlockSpec(memory_space=pltpu.ANY)
+        return pl.BlockSpec(memory_space=pl.ANY)
 
     call = pl.pallas_call(
         partial(_hbm_replay_kernel, K=block_k, NB=NB, NSUP=NSUP,

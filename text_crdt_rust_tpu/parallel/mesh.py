@@ -24,6 +24,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..ops.batch import prefill_logs
 from ..ops.flat import _check_capacity, step
 from ..ops.span_arrays import FlatDoc
 
@@ -98,7 +99,8 @@ def shard_ops(ops, mesh: Mesh, batched: bool = True):
     )
 
 
-def make_sharded_apply(mesh: Mesh, donate: bool = True):
+def make_sharded_apply(mesh: Mesh, donate: bool = True,
+                       prefill: bool = True):
     """The full multi-chip apply step, jitted over the mesh.
 
     Returns ``apply(docs, ops) -> docs`` where docs are sharded
@@ -106,6 +108,13 @@ def make_sharded_apply(mesh: Mesh, donate: bool = True):
     axis sharded ``P(None,'dp')``. This is the framework's "training step"
     equivalent: the whole op-apply pipeline (position scan, YATA integrate,
     splice, tombstoning) under one pjit.
+
+    ``prefill`` runs ``batch.prefill_logs`` on the docs before each apply
+    (host-side; see ``ops.flat.apply_ops``). The device step only writes
+    the origins a *local* insert discovers, so a fresh ``make_flat_doc``
+    applied without prefilled logs gives silently wrong results (NUL
+    chars, wrong tiebreak ranks). Pass ``prefill=False`` only when the
+    docs' logs were already prefilled for this op stream.
     """
     vstep = jax.vmap(step)
 
@@ -128,14 +137,18 @@ def make_sharded_apply(mesh: Mesh, donate: bool = True):
 
     def checked(docs: FlatDoc, ops) -> FlatDoc:
         _check_capacity(docs, ops)
+        if prefill:
+            docs = shard_docs(prefill_logs(docs, ops), mesh)
         return jitted(docs, ops)
 
     return checked
 
 
-def make_sharded_apply_1doc(mesh: Mesh):
+def make_sharded_apply_1doc(mesh: Mesh, prefill: bool = True):
     """Sequence-parallel apply for ONE huge document: capacity axis sharded
-    ``P('sp')`` across every chip in the mesh (long-context path)."""
+    ``P('sp')`` across every chip in the mesh (long-context path).
+
+    ``prefill`` as in ``make_sharded_apply`` — required for fresh docs."""
     specs = doc_pspecs(batched=False)
     in_doc_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
 
@@ -154,6 +167,8 @@ def make_sharded_apply_1doc(mesh: Mesh):
 
     def checked(doc: FlatDoc, ops) -> FlatDoc:
         _check_capacity(doc, ops)
+        if prefill:
+            doc = shard_docs(prefill_logs(doc, ops), mesh, batched=False)
         return jitted(doc, ops)
 
     return checked
