@@ -1,13 +1,16 @@
 """North-star benchmark: automerge-paper replay tiled across a doc batch.
 
-Replays a prefix of the automerge-paper editing trace (the
-`benches/yjs.rs:32-49` workload) across ``--batch`` identical documents on
-the device engine, all docs advanced per step by one vmapped+scanned apply
-kernel. Reports aggregate CRDT ops/sec/chip.
+Replays the automerge-paper editing trace — by default the FULL 259,778
+patches, the `benches/yjs.rs:32-49` workload with its final-content
+assertion (`yjs.rs:46`) — across ``--batch`` identical documents on a
+device engine. Reports aggregate CRDT ops/sec/chip.
 
-Baseline: 0.29 M ops/s single-core on the native C++ engine replaying the
-full trace (BASELINE.md, measured); ``vs_baseline`` is the ratio against
-that row. Prints exactly ONE JSON line on stdout.
+``vs_baseline`` is an EQUAL-WORKLOAD ratio: the native C++ engine
+(``models.native``, the CPU reference stand-in) replays the *same* patch
+list single-core at bench time, so the denominator always matches the
+numerator's workload (full trace or ``--patches`` prefix).
+
+Prints exactly ONE JSON line on stdout; everything else goes to stderr.
 """
 from __future__ import annotations
 
@@ -29,8 +32,6 @@ from text_crdt_rust_tpu.utils.testdata import (
     trace_path,
 )
 
-CPU_BASELINE_OPS_PER_SEC = 290_000.0  # BASELINE.md automerge-paper row
-
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
@@ -43,22 +44,78 @@ def expected_content(patches) -> str:
     return s
 
 
-def bench_blocked(args, ops, patches, n_ops, capacity) -> None:
-    """One-kernel blocked replay (``ops.blocked``): docs ride the lane
-    dimension (batch is in units of 128 lanes). Timed over several runs —
+def measure_cpu_baseline(patches, reps: int = 3) -> float:
+    """Single-core ops/s of the native C++ engine on the SAME workload
+    (fills the BASELINE.md row at bench time; best of ``reps``)."""
+    from text_crdt_rust_tpu.models.native import NativeListCRDT
+
+    pos = [p.pos for p in patches]
+    dels = [p.del_len for p in patches]
+    ilens = [len(p.ins_content) for p in patches]
+    cps = np.frombuffer(
+        "".join(p.ins_content for p in patches).encode("utf-32-le"),
+        dtype=np.uint32)
+    best = float("inf")
+    for _ in range(reps):
+        doc = NativeListCRDT()
+        agent = doc.get_or_create_agent_id("bench")
+        t0 = time.perf_counter()
+        doc.replay_trace(agent, pos, dels, ilens, cps)
+        best = min(best, time.perf_counter() - t0)
+    want = expected_content(patches)
+    got = doc.to_string()
+    assert got == want, "native baseline replay diverged from string oracle"
+    return len(patches) / best
+
+
+def emit(n_ops, batch, wall, steps, hbm_bytes, baseline_ops, extra=None):
+    total_ops = n_ops * batch
+    ops_per_sec = total_ops / wall
+    log(f"wall {wall:.3f}s/run, {total_ops} ops -> {ops_per_sec:,.0f} ops/s "
+        f"(baseline {baseline_ops:,.0f} ops/s single-core, same workload)")
+    row = {
+        "metric": "crdt_ops_per_sec_chip",
+        "value": round(ops_per_sec, 1),
+        "unit": "ops/s",
+        "vs_baseline": round(ops_per_sec / baseline_ops, 3),
+        "p50_step_latency_us": round(wall / steps * 1e6, 3),
+        "hbm_bytes": int(hbm_bytes),
+        "ops": int(n_ops),
+        "batch": int(batch),
+    }
+    if extra:
+        row.update(extra)
+    print(json.dumps(row))
+
+
+def bench_blocked(args, ops, patches, n_ops, capacity, baseline_ops) -> None:
+    """One-kernel blocked replay: docs ride the lane dimension (batch in
+    units of 128 lanes). ``--engine blocked`` holds the document in VMEM
+    (caps near ~50k rows); ``--engine hbm`` keeps state in HBM with a
+    DMA'd VMEM window, so the FULL trace fits. Timed over several runs —
     device round-trip latency on the tunneled chip (~70ms) would otherwise
     swamp the kernel."""
     from text_crdt_rust_tpu.ops import blocked as BL
+    from text_crdt_rust_tpu.ops import blocked_hbm as BH
 
     batch = max(128, (args.batch // 128) * 128)
     # Headroom: rebalance degrades as fill -> K-lmax; 2x keeps fill <= K/2.
     cap = capacity * 2
     block_k = min(args.block_k, cap // 2)  # small prefixes: >= 2 blocks
-    log(f"blocked engine: batch {batch} (128-lane units), capacity {cap}, "
-        f"block_k {block_k}")
-    run = BL.make_replayer(
-        ops, capacity=cap, batch=batch,
-        block_k=block_k, chunk=args.chunk)
+    log(f"{args.engine} engine: batch {batch} (128-lane units), "
+        f"capacity {cap}, block_k {block_k}")
+    if args.engine == "hbm":
+        run = BH.make_replayer_hbm(
+            ops, capacity=cap, batch=batch,
+            block_k=block_k, chunk=args.chunk, interpret=args.interpret)
+        # state + tmp (HBM-resident) + origin outputs
+        hbm_bytes = (2 * cap + block_k) * batch * 4 \
+            + 2 * ops.num_steps * batch * 4
+    else:
+        run = BL.make_replayer(
+            ops, capacity=cap, batch=batch,
+            block_k=block_k, chunk=args.chunk, interpret=args.interpret)
+        hbm_bytes = cap * batch * 4 + 2 * ops.num_steps * batch * 4
 
     log("compiling...")
     t0 = time.perf_counter()
@@ -66,7 +123,7 @@ def bench_blocked(args, ops, patches, n_ops, capacity) -> None:
     res.check()  # forces completion
     log(f"first run (incl. compile): {time.perf_counter() - t0:.2f}s")
 
-    reps = 5
+    reps = args.reps
     t0 = time.perf_counter()
     for _ in range(reps):
         res = run()
@@ -76,52 +133,13 @@ def bench_blocked(args, ops, patches, n_ops, capacity) -> None:
     want = expected_content(patches)
     doc = BL.blocked_to_flat(ops, res)
     got = SA.to_string(doc)
-    assert got == want, "blocked replay diverged from string oracle"
+    assert got == want, f"{args.engine} replay diverged from string oracle"
 
-    total_ops = n_ops * batch
-    ops_per_sec = total_ops / wall
-    log(f"wall {wall:.3f}s/run (avg of {reps}), {total_ops} ops -> "
-        f"{ops_per_sec:,.0f} ops/s")
-    print(json.dumps({
-        "metric": "crdt_ops_per_sec_chip",
-        "value": round(ops_per_sec, 1),
-        "unit": "ops/s",
-        "vs_baseline": round(ops_per_sec / CPU_BASELINE_OPS_PER_SEC, 3),
-    }))
+    emit(n_ops, batch, wall, ops.num_steps, hbm_bytes, baseline_ops,
+         extra={"engine": args.engine, "reps": reps})
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--trace", default="automerge-paper")
-    ap.add_argument("--patches", type=int, default=30000,
-                    help="trace prefix length (full trace: 0)")
-    ap.add_argument("--batch", type=int, default=128)
-    ap.add_argument("--lmax", type=int, default=16)
-    ap.add_argument("--engine", choices=("flat", "blocked"),
-                    default="blocked")
-    ap.add_argument("--block-k", type=int, default=256)
-    ap.add_argument("--chunk", type=int, default=1024)
-    args = ap.parse_args()
-
-    dev = jax.devices()[0]
-    log(f"device: {dev.platform} {dev.device_kind}")
-
-    data = load_testing_data(trace_path(args.trace))
-    patches = flatten_patches(data)
-    if args.patches:
-        patches = patches[:args.patches]
-    n_ops = len(patches)
-    ins_total = sum(len(p.ins_content) for p in patches)
-    capacity = 1 << int(np.ceil(np.log2(max(ins_total, 64))))
-    dmax = args.lmax if args.engine == "blocked" else None
-    ops, _ = B.compile_local_patches(patches, lmax=args.lmax, dmax=dmax)
-    steps = ops.num_steps
-    log(f"{args.trace}[:{n_ops}] -> {steps} device steps, "
-        f"capacity {capacity}, batch {args.batch}")
-
-    if args.engine == "blocked":
-        return bench_blocked(args, ops, patches, n_ops, capacity)
-
+def bench_flat(args, ops, patches, n_ops, capacity, baseline_ops) -> None:
     # Identical docs share one op stream: vmap with in_axes=None keeps the
     # uploaded stream at [S, ...] (no host-side tiling, ~MBs not GBs). The
     # stream is pure local edits, so the remote paths compile out.
@@ -145,8 +163,7 @@ def main() -> None:
     t0 = time.perf_counter()
     out = replay(docs, ops)
     jax.block_until_ready(out)
-    t_first = time.perf_counter() - t0
-    log(f"first run (incl. compile): {t_first:.2f}s")
+    log(f"first run (incl. compile): {time.perf_counter() - t0:.2f}s")
 
     t0 = time.perf_counter()
     out = replay(docs, ops)
@@ -160,16 +177,60 @@ def main() -> None:
     assert got == want, "device replay diverged from string oracle"
     assert int(np.asarray(out.n).min()) == int(np.asarray(out.n).max())
 
-    total_ops = n_ops * args.batch
-    ops_per_sec = total_ops / wall
-    log(f"wall {wall:.3f}s, {total_ops} ops -> {ops_per_sec:,.0f} ops/s")
+    hbm_bytes = sum(
+        np.asarray(x).nbytes for x in jax.tree.leaves(docs))
+    emit(n_ops, args.batch, wall, ops.num_steps, hbm_bytes, baseline_ops,
+         extra={"engine": "flat"})
 
-    print(json.dumps({
-        "metric": "crdt_ops_per_sec_chip",
-        "value": round(ops_per_sec, 1),
-        "unit": "ops/s",
-        "vs_baseline": round(ops_per_sec / CPU_BASELINE_OPS_PER_SEC, 3),
-    }))
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trace", default="automerge-paper")
+    ap.add_argument("--patches", type=int, default=0,
+                    help="trace prefix length (0 = FULL trace, the "
+                         "`benches/yjs.rs` workload)")
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--lmax", type=int, default=16)
+    ap.add_argument("--engine", choices=("flat", "blocked", "hbm"),
+                    default="hbm")
+    ap.add_argument("--block-k", type=int, default=512)
+    ap.add_argument("--chunk", type=int, default=1024)
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (logic check, not a perf "
+                         "number; implies --interpret for blocked/hbm)")
+    ap.add_argument("--interpret", action="store_true",
+                    help="run Pallas kernels in interpreter mode")
+    args = ap.parse_args()
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+        args.interpret = True
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform} {dev.device_kind}")
+
+    data = load_testing_data(trace_path(args.trace))
+    patches = flatten_patches(data)
+    if args.patches:
+        patches = patches[:args.patches]
+    n_ops = len(patches)
+    ins_total = sum(len(p.ins_content) for p in patches)
+    capacity = 1 << int(np.ceil(np.log2(max(ins_total, 64))))
+    dmax = args.lmax if args.engine in ("blocked", "hbm") else None
+    ops, _ = B.compile_local_patches(patches, lmax=args.lmax, dmax=dmax)
+    steps = ops.num_steps
+    log(f"{args.trace}[:{n_ops}] -> {steps} device steps, "
+        f"capacity {capacity}, batch {args.batch}")
+
+    log("measuring single-core CPU baseline on the same workload...")
+    baseline_ops = measure_cpu_baseline(patches)
+    log(f"native C++ single-core: {baseline_ops:,.0f} ops/s")
+
+    if args.engine in ("blocked", "hbm"):
+        return bench_blocked(args, ops, patches, n_ops, capacity,
+                             baseline_ops)
+    return bench_flat(args, ops, patches, n_ops, capacity, baseline_ops)
 
 
 if __name__ == "__main__":
