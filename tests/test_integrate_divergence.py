@@ -1,0 +1,146 @@
+"""Pin the deliberate integrate deviation from the reference.
+
+`/root/reference/src/list/doc.rs:214-215` re-pins ``scan_start = cursor``
+on *every* scanning iteration of the YATA conflict walk. Yjs's
+``Item.integrate`` keeps the insert-before point pinned at the FIRST
+conflicting item unless the name tiebreak says "we go after" — and the
+re-pinning rule is not convergent. All engines in this repo pin
+``scan_start`` only on the false→true ``scanning`` transition
+(``models/oracle.py:235-237``, ``native/tcr_engine.cpp``,
+``ops/flat.py:109``).
+
+The counterexample (the one claimed in the round-1 code comment, now
+executable): three peers build a chain of items that all have
+``origin_left == ROOT`` — D types "D" into the empty doc (origins
+(ROOT, ROOT)), E inserts "E" at position 0 ((ROOT, D)), F inserts "F" at
+position 0 ((ROOT, E)) — and a fourth peer A, whose name sorts *lowest*,
+concurrently types "A" into the empty doc ((ROOT, ROOT)).
+
+Integrating A last walks: F → eq-cursor conflict, A < F, different
+origin_right ⇒ scanning, scan_start=0; E → same ⇒ reference re-pins
+scan_start=1; D → same origin_right (ROOT) ⇒ break. Reference rule
+inserts at 1 → "FAED". But with the other arrival order (D, A, E, F)
+every rule gives "AFED" — so re-pinning does not converge. The pinned
+rule inserts at 0 → "AFED" both ways.
+"""
+import pytest
+
+from text_crdt_rust_tpu.common import (
+    ROOT_ORDER,
+    RemoteId,
+    RemoteIns,
+    RemoteTxn,
+)
+from text_crdt_rust_tpu.models.native import NativeListCRDT
+from text_crdt_rust_tpu.models.oracle import ListCRDT
+
+ROOT = RemoteId("ROOT", ROOT_ORDER)
+
+
+def _simulate(arrivals, repin: bool) -> str:
+    """Minimal YATA integrate over (name, char, left, right) items, with
+    the reference's re-pinning rule (``repin=True``, `doc.rs:183-222`) or
+    the pinned fix. Origins name items by their char ('' = ROOT). The doc
+    is a list of items; cursors are list indices."""
+    doc = []  # (name, char, left, right)
+
+    def cursor_after(origin_char):
+        if origin_char == "":
+            return 0
+        return next(i for i, it in enumerate(doc) if it[1] == origin_char) + 1
+
+    for item in arrivals:
+        name, char, left, right = item
+        cursor = cursor_after(left)
+        left_cursor = cursor
+        scan_start = cursor
+        scanning = False
+        while cursor < len(doc):
+            o_name, o_char, o_left, o_right = doc[cursor]
+            if o_char == right:
+                break
+            olc = cursor_after(o_left)
+            if olc < left_cursor:
+                break
+            if olc == left_cursor:
+                if name > o_name:
+                    scanning = False
+                elif right == o_right:
+                    break
+                else:
+                    if repin or not scanning:
+                        scan_start = cursor
+                    scanning = True
+            cursor += 1
+        if scanning:
+            cursor = scan_start
+        doc.insert(cursor, item)
+    return "".join(it[1] for it in doc)
+
+
+# The four concurrent items of the counterexample. Causal deps: E after D,
+# F after E; A independent.
+ITEM_D = ("dan", "D", "", "")
+ITEM_E = ("eve", "E", "", "D")
+ITEM_F = ("fred", "F", "", "E")
+ITEM_A = ("amy", "A", "", "")
+
+ORDER_1 = [ITEM_D, ITEM_E, ITEM_F, ITEM_A]   # A integrates into the chain
+ORDER_2 = [ITEM_D, ITEM_A, ITEM_E, ITEM_F]   # A arrives early
+
+
+class TestScanStartRule:
+    def test_reference_rule_not_convergent(self):
+        # The reference's re-pinning rule gives different documents for the
+        # two (both causally valid) arrival orders.
+        got_1 = _simulate(ORDER_1, repin=True)
+        got_2 = _simulate(ORDER_2, repin=True)
+        assert got_1 == "FAED"
+        assert got_2 == "AFED"
+        assert got_1 != got_2   # the divergence this repo fixes
+
+    def test_pinned_rule_convergent(self):
+        assert _simulate(ORDER_1, repin=False) == "AFED"
+        assert _simulate(ORDER_2, repin=False) == "AFED"
+
+
+def _txns():
+    return {
+        "D": RemoteTxn(id=RemoteId("dan", 0), parents=[],
+                       ops=[RemoteIns(ROOT, ROOT, "D")]),
+        "E": RemoteTxn(id=RemoteId("eve", 0), parents=[RemoteId("dan", 0)],
+                       ops=[RemoteIns(ROOT, RemoteId("dan", 0), "E")]),
+        "F": RemoteTxn(id=RemoteId("fred", 0), parents=[RemoteId("eve", 0)],
+                       ops=[RemoteIns(ROOT, RemoteId("eve", 0), "F")]),
+        "A": RemoteTxn(id=RemoteId("amy", 0), parents=[],
+                       ops=[RemoteIns(ROOT, ROOT, "A")]),
+    }
+
+
+ARRIVALS = [list("DEFA"), list("DAEF"), list("ADEF"), list("DEAF")]
+
+
+class TestEnginesConverge:
+    @pytest.mark.parametrize("engine", ["oracle", "native"])
+    def test_all_arrival_orders_converge(self, engine):
+        results = []
+        for order in ARRIVALS:
+            txns = _txns()
+            doc = ListCRDT() if engine == "oracle" else NativeListCRDT()
+            for key in order:
+                doc.apply_remote_txn(txns[key])
+            results.append(doc.to_string())
+        assert all(r == "AFED" for r in results), results
+
+    def test_flat_engine_converges(self):
+        from text_crdt_rust_tpu.ops import batch as B
+        from text_crdt_rust_tpu.ops import flat as F
+        from text_crdt_rust_tpu.ops import span_arrays as SA
+
+        for order in ARRIVALS:
+            txns = _txns()
+            table = B.AgentTable(["dan", "eve", "fred", "amy"])
+            ops, _ = B.compile_remote_txns(
+                [txns[k] for k in order], table, lmax=4)
+            doc = F.apply_ops(SA.make_flat_doc(64), ops)
+            assert SA.to_string(doc) == "AFED"
