@@ -114,10 +114,13 @@ struct Doc {
     std::vector<Node> nodes;
     int root = NIL;
     Rng rng;
-    // span start order -> node id. Starts never change after creation
-    // (splits only create new right halves), so entries are never stale.
+    // span start order -> node id. Splits only create new right halves;
+    // the one op that changes an existing start is the prepend-merge fast
+    // path, which re-keys its entry in place.
     std::map<u32, int> order_index;
     std::vector<u32> chars;  // codepoint per *insert* order (delete ops: gaps)
+    std::vector<int> free_nodes;  // slots freed by the tombstone merge
+    u32 n_spans = 0;              // live span count (nodes minus freed)
 
     std::vector<CwoEntry> client_with_order;
     std::vector<ClientData> clients;
@@ -150,38 +153,61 @@ struct Doc {
         n.l = n.r = n.p = NIL;
         n.sum_raw = uabs(len);
         n.sum_content = (u32)std::max(len, 0);
-        nodes.push_back(n);
-        int id = (int)nodes.size() - 1;
+        int id;
+        if (!free_nodes.empty()) {
+            id = free_nodes.back();
+            free_nodes.pop_back();
+            nodes[id] = n;
+        } else {
+            nodes.push_back(n);
+            id = (int)nodes.size() - 1;
+        }
         order_index[order] = id;
+        n_spans++;
         return id;
     }
 
-    // Split by raw position: a = first k raw items, b = rest.
+    // Detach a node the tombstone merge absorbed into a neighbor: its
+    // orders are now covered by the neighbor's order_index entry. The
+    // caller settles the order_index keys itself (the absorbed start key
+    // is erased on an append-merge but RE-POINTED on a prepend-merge).
+    void discard_node(int id) {
+        free_nodes.push_back(id);
+        n_spans--;
+    }
+
+    // Split at measure k: a = minimal prefix whose measure is k, b = rest.
+    // BY_CONTENT=false splits by raw item count; BY_CONTENT=true splits by
+    // live-char count (boundary tombstones, measure 0, go to b — "minimal
+    // prefix"). The in-span cut keeps the `span.rs:33-45` origin fix-up:
+    // right half gets order+off, origin_left = order+off-1. A content cut
+    // can only land inside a live span, where raw and live offsets
+    // coincide, so one inner branch serves both.
     // NB: `nodes` may reallocate inside new_node(); never hold a Node&
     // across it.
-    void split(int t, u32 k, int* a, int* b) {
+    template <bool BY_CONTENT>
+    void split_impl(int t, u32 k, int* a, int* b) {
         if (t == NIL) { *a = *b = NIL; return; }
-        u32 lr = raw(nodes[t].l);
-        u32 sl = uabs(nodes[t].len);
-        if (k <= lr) {
+        u32 lm = BY_CONTENT ? content(nodes[t].l) : raw(nodes[t].l);
+        u32 sl = BY_CONTENT ? (u32)std::max(nodes[t].len, 0)
+                            : uabs(nodes[t].len);
+        if (k <= lm) {
             int nl;
-            split(nodes[t].l, k, a, &nl);
+            split_impl<BY_CONTENT>(nodes[t].l, k, a, &nl);
             nodes[t].l = nl;
             *b = t;
             nodes[t].p = NIL;
             pull(t);
-        } else if (k >= lr + sl) {
+        } else if (k >= lm + sl) {
             int nr;
-            split(nodes[t].r, k - lr - sl, &nr, b);
+            split_impl<BY_CONTENT>(nodes[t].r, k - lm - sl, &nr, b);
             nodes[t].r = nr;
             *a = t;
             nodes[t].p = NIL;
             pull(t);
         } else {
-            // Split inside this span at offset off (`span.rs:33-45`):
-            // right half gets order+off, origin_left = order+off-1.
-            u32 off = k - lr;
-            i32 sign = nodes[t].len < 0 ? -1 : 1;
+            u32 off = k - lm;
+            i32 sign = nodes[t].len < 0 ? -1 : 1;  // BY_CONTENT: always +1
             u32 o = nodes[t].order;
             u32 orr_ = nodes[t].orr;
             i32 rest_len = nodes[t].len - sign * (i32)off;
@@ -198,6 +224,14 @@ struct Doc {
             *a = t; nodes[t].p = NIL;
             *b = rid; nodes[rid].p = NIL;
         }
+    }
+
+    void split(int t, u32 k, int* a, int* b) {
+        split_impl<false>(t, k, a, b);
+    }
+
+    void split_content(int t, u32 k, int* a, int* b) {
+        split_impl<true>(t, k, a, b);
     }
 
     int merge(int a, int b) {
@@ -514,26 +548,41 @@ struct Doc {
 
     // ---- integrate (`doc.rs:167-234`) ----
 
+    // Bump every sum on the path node -> root by (draw, dcontent). The
+    // in-place fast paths use this instead of full pull()s: one add per
+    // level, no child re-reads.
+    inline void bump_sums(int nid, i32 draw, i32 dcontent) {
+        for (int c = nid; c != NIL; c = nodes[c].p) {
+            nodes[c].sum_raw = (u32)((i32)nodes[c].sum_raw + draw);
+            nodes[c].sum_content = (u32)((i32)nodes[c].sum_content + dcontent);
+        }
+    }
+
     // Insert a run at raw position `cursor`, merging into the predecessor
     // span when the YjsSpan append predicate allows (`span.rs:47-53`).
+    // (No prepend case here: orders are allocated monotonically and
+    // integrated immediately, so a fresh run can never precede an existing
+    // span in order space. The reference's prepend optimization
+    // `mutations.rs:84-109` is about *tombstones* — see local_deactivate.)
     void insert_run_at(u32 cursor, u32 order, u32 ol, u32 orr, u32 len) {
-        int a, b;
-        split(root, cursor, &a, &b);
-        // Predecessor = rightmost span of `a`.
-        if (a != NIL) {
-            int t = a;
-            while (nodes[t].r != NIL) t = nodes[t].r;
-            Node& pn = nodes[t];
-            if (pn.len > 0 && order == pn.order + (u32)pn.len &&
-                ol == order - 1 && orr == pn.orr) {
-                pn.len += (i32)len;
-                // Recompute sums up to a's root.
-                int c = t;
-                while (c != NIL) { pull(c); c = nodes[c].p; }
-                root = merge(a, b);
-                return;
+        // Fast path 1 (the typing hot path): the item just before the
+        // cursor is the END of a live span the run appends to. Extend the
+        // span in place — no split/merge node churn, just a sum walk.
+        if (cursor > 0 && ol != ROOT_ORDER) {
+            int nid; u32 off;
+            if (item_at_raw(cursor - 1, &nid, &off)) {
+                Node& pn = nodes[nid];
+                if (pn.len > 0 && off == (u32)pn.len - 1 &&
+                    order == pn.order + (u32)pn.len &&
+                    ol == order - 1 && orr == pn.orr) {
+                    pn.len += (i32)len;
+                    bump_sums(nid, (i32)len, (i32)len);
+                    return;
+                }
             }
         }
+        int a, b;
+        split(root, cursor, &a, &b);
         int nn = new_node(order, ol, orr, (i32)len);
         root = merge(merge(a, nn), b);
     }
@@ -593,14 +642,69 @@ struct Doc {
     bool local_deactivate(u32 pos, u32 del_span, u32* next_order_io) {
         if (pos + del_span > n_content()) return fail("delete past end");
         u32 i = raw_of_content(pos);
-        u32 j = raw_of_content(pos + del_span);
-        int a, m, c, b;
-        split(root, j, &a, &c);
-        split(a, i, &a, &m);
+        int a, m, c, rest;
+        split(root, i, &a, &rest);
+        // Content split keeps boundary tombstones out of m, so flip_live
+        // walks exactly the spans covering the del_span live chars.
+        split_content(rest, del_span, &m, &c);
         // Flip all live spans in m (in-order), collecting delete runs.
         std::vector<std::pair<u32, u32>> runs;
         flip_live(m, runs);
+        // Tombstone boundary merge — the real analog of the reference's
+        // prepend optimization (`mutations.rs:84-109`, "improves
+        // performance when the user hits backspace... merging all the
+        // deleted elements together"): when the freshly flipped span is a
+        // single node, try to absorb it into an order-adjacent tombstone
+        // neighbor (the span.rs:47-53 predicate, both signs negative).
+        // Backspace runs merge rightward; forward-delete runs leftward.
+        if (m != NIL && nodes[m].l == NIL && nodes[m].r == NIL) {
+            const Node& mn = nodes[m];
+            if (a != NIL) {   // append m after a's rightmost span
+                int t = a;
+                while (nodes[t].r != NIL) t = nodes[t].r;
+                const Node& ra = nodes[t];
+                if (ra.len < 0 && mn.order == ra.order + uabs(ra.len) &&
+                    mn.ol == mn.order - 1 && mn.orr == ra.orr) {
+                    u32 grow = uabs(mn.len);
+                    order_index.erase(mn.order);
+                    nodes[t].len -= (i32)grow;   // more negative
+                    for (int w = a; ; w = nodes[w].r) {
+                        nodes[w].sum_raw += grow;
+                        if (w == t) break;
+                    }
+                    discard_node(m);
+                    root = merge(a, c);
+                    return finish_deactivate(runs, next_order_io);
+                }
+            }
+            if (c != NIL) {   // prepend m before c's leftmost span
+                int t = c;
+                while (nodes[t].l != NIL) t = nodes[t].l;
+                const Node& cl = nodes[t];
+                if (cl.len < 0 && cl.order == mn.order + uabs(mn.len) &&
+                    cl.ol == cl.order - 1 && cl.orr == mn.orr) {
+                    u32 grow = uabs(mn.len);
+                    order_index.erase(cl.order);
+                    nodes[t].order = mn.order;
+                    nodes[t].ol = mn.ol;
+                    nodes[t].len -= (i32)grow;
+                    order_index[mn.order] = t;  // re-points m's old entry
+                    for (int w = c; ; w = nodes[w].l) {
+                        nodes[w].sum_raw += grow;
+                        if (w == t) break;
+                    }
+                    discard_node(m);
+                    root = merge(a, c);
+                    return finish_deactivate(runs, next_order_io);
+                }
+            }
+        }
         root = merge(merge(a, m), c);
+        return finish_deactivate(runs, next_order_io);
+    }
+
+    bool finish_deactivate(const std::vector<std::pair<u32, u32>>& runs,
+                           u32* next_order_io) {
         u32 nord = *next_order_io;
         for (auto& rn : runs) {
             deletes_append(nord, rn.first, rn.second);
@@ -814,7 +918,7 @@ u32 tcr_get_or_create_agent(void* d, const char* name) {
 u32 tcr_len(void* d) { return ((Doc*)d)->n_content(); }
 u32 tcr_raw_len(void* d) { return ((Doc*)d)->n_raw(); }
 u32 tcr_next_order(void* d) { return ((Doc*)d)->next_order(); }
-u32 tcr_num_spans(void* d) { return (u32)((Doc*)d)->nodes.size(); }
+u32 tcr_num_spans(void* d) { return ((Doc*)d)->n_spans; }
 
 int tcr_apply_local_txn(void* dv, u32 agent, u32 n_ops, const u32* pos,
                         const u32* dels, const u32* ins_lens,
