@@ -14,7 +14,11 @@ see ``span_arrays``):
                 the document is a fixed grid of blocks; each op touches one
                 block plus an O(num_blocks) index, with periodic all-doc
                 rebalance passes replacing the reference B-tree's node splits
-                (`range_tree/mutations.rs:623-808`).
+                (`range_tree/mutations.rs:623-808`). Variants:
+                ``blocked_hbm`` keeps the block grid in HBM behind a DMA'd
+                VMEM window (full-trace documents), and ``blocked_mixed``
+                adds the remote-op hot path in-kernel (YATA integrate +
+                order-range deletes over an order->block index).
 
 ``batch`` compiles editing traces into fixed-shape op tensors (the host-side
 analog of the reference's bench replay loop, `benches/yjs.rs:32-49`).

@@ -289,13 +289,17 @@ def compile_remote_txns(
     table: AgentTable,
     assigner: Optional[OrderAssigner] = None,
     lmax: int = 16,
+    dmax: Optional[int] = None,
 ) -> Tuple[OpTensors, OrderAssigner]:
     """Causally-ordered RemoteTxn stream -> op tensors (`doc.rs:242-348`).
 
     The ``assigner`` carries the peer-local order metadata between calls
     (streaming apply); txns must arrive causally ready — buffering
-    out-of-order arrivals is ``parallel.causal``'s job.
+    out-of-order arrivals is ``parallel.causal``'s job. ``dmax`` chunks
+    remote delete target runs (the blocked mixed engine bounds per-step
+    targets; the flat engine masks whole order ranges, so None there).
     """
+    assert dmax is None or dmax >= 1, f"dmax must be >= 1, got {dmax}"
     if assigner is None:
         assigner = OrderAssigner(table)
     ranks = table.rank_of_agent()
@@ -338,11 +342,16 @@ def compile_remote_txns(
                 target_agent = table.id_of(op.id.agent)
                 for first, run_len in assigner.target_runs(
                         target_agent, op.id.seq, op.len):
-                    rows.emit(
-                        kind=KIND_REMOTE_DEL, del_target=first,
-                        del_len=run_len, order_advance=run_len,
-                        rank=int(ranks[agent]),
-                    )
+                    off = 0
+                    while off < run_len:
+                        take = (run_len - off if dmax is None
+                                else min(run_len - off, dmax))
+                        rows.emit(
+                            kind=KIND_REMOTE_DEL, del_target=first + off,
+                            del_len=take, order_advance=take,
+                            rank=int(ranks[agent]),
+                        )
+                        off += take
                     cursor += run_len
     return rows.to_tensors(), assigner
 

@@ -87,6 +87,142 @@ def _shift_rows(x, amount, max_amount: int) -> jax.Array:
     return out
 
 
+class _BlockOps:
+    """The shared VMEM block-grid op set, closed over a kernel's scratch
+    refs. Both ``blocked`` and ``blocked_mixed`` build their kernels on
+    these — one implementation of the descent, the rebalance (node-split
+    analog) and the windowed local delete, so the engines cannot drift.
+    """
+
+    def __init__(self, sig, rws, liv, tmp, err_ref, *, K, NB, LMAX):
+        self.sig, self.rws, self.liv, self.tmp = sig, rws, liv, tmp
+        self.err_ref = err_ref
+        self.K, self.NB, self.LMAX = K, NB, LMAX
+        self.B = sig.shape[1]
+        self.idx_nb = lax.broadcasted_iota(jnp.int32, rws.shape, 0)
+        self.idx_k = lax.broadcasted_iota(jnp.int32, (K, self.B), 0)
+        self.idx_2k = lax.broadcasted_iota(jnp.int32, (2 * K, self.B), 0)
+
+    def live_before_block(self, b):
+        return _lane_scalar(jnp.where(self.idx_nb < b, self.liv[:], 0))
+
+    def raw_before_block(self, b):
+        return _lane_scalar(jnp.where(self.idx_nb < b, self.rws[:], 0))
+
+    def block_of_rank(self, rank1):
+        """Smallest block whose cumulative live count reaches ``rank1``
+        (the B-tree descent `root.rs:54-88` over block sums)."""
+        cumlive = _cumsum_rows(
+            jnp.where(self.idx_nb < self.NB, self.liv[:], 0))
+        hits = (cumlive < rank1) & (self.idx_nb < self.NB)
+        return jnp.max(jnp.sum(hits.astype(jnp.int32), axis=0))
+
+    def block_rows(self, b):
+        return _lane_scalar(jnp.where(self.idx_nb == b, self.rws[:], 0))
+
+    def total_raw(self):
+        return _lane_scalar(jnp.where(self.idx_nb < self.NB, self.rws[:], 0))
+
+    def rebalance(self):
+        """Compact all packed rows, redeal evenly (`mutations.rs:623-808`
+        analog). O(cap); triggered only on block overflow."""
+        K, NB, B = self.K, self.NB, self.B
+        sig, rws, liv, tmp = self.sig, self.rws, self.liv, self.tmp
+        total = self.total_raw()
+        fill = (total + NB - 1) // NB
+        err_ref = self.err_ref
+
+        @pl.when(fill > K - self.LMAX)
+        def _overflow():
+            err_ref[0:1, :] = jnp.ones((1, B), jnp.int32)
+
+        def compact(j, off):
+            rows_j = self.block_rows(j)
+            tmp[pl.ds(off, K), :] = sig[pl.ds(j * K, K), :]
+            return off + rows_j
+
+        lax.fori_loop(0, NB, compact, 0)
+
+        def deal(j, _):
+            rows_j = jnp.clip(total - j * fill, 0, fill)
+            blk = tmp[pl.ds(j * fill, K), :]
+            nblk = jnp.where(self.idx_k < rows_j, blk, 0)
+            sig[pl.ds(j * K, K), :] = nblk
+            rws[pl.ds(j, 1), :] = jnp.broadcast_to(rows_j, (1, B))
+            liv[pl.ds(j, 1), :] = jnp.sum(
+                (nblk > 0).astype(jnp.int32), axis=0, keepdims=True)
+            return 0
+
+        lax.fori_loop(0, NB, deal, 0)
+
+    def local_delete(self, p, d):
+        """Tombstone ``d`` live chars after content pos ``p``
+        (`mutations.rs:520-570`); walks 2-block windows across the span."""
+        K, NB = self.K, self.NB
+        sig, liv = self.sig, self.liv
+        err_ref = self.err_ref
+
+        def body(carry):
+            rem, iters = carry
+            b = jnp.minimum(self.block_of_rank(p + 1), NB - 2)
+            base = self.live_before_block(b)
+            win = sig[pl.ds(b * K, 2 * K), :]
+            wlive = win > 0
+            rank = base + _cumsum_rows(wlive.astype(jnp.int32))
+            flip = wlive & (rank > p) & (rank <= p + rem)
+            sig[pl.ds(b * K, 2 * K), :] = jnp.where(flip, -win, win)
+            fcounts = flip.astype(jnp.int32)
+            f0 = _lane_scalar(jnp.where(self.idx_2k < K, fcounts, 0))
+            f1 = _lane_scalar(jnp.where(self.idx_2k >= K, fcounts, 0))
+            liv[pl.ds(b, 1), :] = liv[pl.ds(b, 1), :] - f0
+            liv[pl.ds(b + 1, 1), :] = liv[pl.ds(b + 1, 1), :] - f1
+            return rem - f0 - f1, iters + 1
+
+        # Iteration bound: each window contains >= 1 target char for a
+        # valid stream, so NB+1 windows means the delete ran off the
+        # document (invalid op) — flag instead of hanging the chip.
+        rem, iters = lax.while_loop(
+            lambda c: (c[0] > 0) & (c[1] <= NB), body, (d, 0))
+
+        @pl.when(rem > 0)
+        def _bad_delete():
+            err_ref[1:2, :] = jnp.ones((1, self.B), jnp.int32)
+
+    def local_insert_block(self, p):
+        """(block, occupied rows) an insert at live rank ``p`` targets —
+        the cheap pre-check before the overflow rebalance."""
+        b = jnp.where(p == 0, 0, self.block_of_rank(p))
+        return b, self.block_rows(b)
+
+    def local_insert_target(self, p):
+        """(block, row-cursor, block-rows, origins) for a local insert at
+        live rank ``p``, with the overflow rebalance already handled.
+        Origins per `doc.rs:447-453`: raw successor without skipping
+        tombstones."""
+        K, NB = self.K, self.NB
+        sig, rws = self.sig, self.rws
+        idx_k, idx_nb = self.idx_k, self.idx_nb
+
+        b, r0 = self.local_insert_block(p)
+        local_rank = p - self.live_before_block(b)
+        blk = sig[pl.ds(b * K, K), :]
+        bcum = _cumsum_rows((blk > 0).astype(jnp.int32))
+        c0 = jnp.max(jnp.sum(
+            (bcum < local_rank).astype(jnp.int32), axis=0))
+        c = jnp.where(p == 0, 0, c0 + 1)
+
+        left_signed = _lane_scalar(jnp.where(idx_k == c - 1, blk, 0))
+        succ_here = _lane_scalar(jnp.where(idx_k == c, blk, 0))
+        nb_next = jnp.max(jnp.min(jnp.where(
+            (idx_nb > b) & (idx_nb < NB) & (rws[:] > 0), idx_nb, NB),
+            axis=0))
+        nxt = sig[pl.ds(jnp.minimum(nb_next, NB - 1) * K, K), :]
+        succ_next = _lane_scalar(jnp.where(idx_k == 0, nxt, 0))
+        succ_signed = jnp.where(c < r0, succ_here,
+                                jnp.where(nb_next < NB, succ_next, 0))
+        return b, c, r0, left_signed, succ_signed
+
+
 def _replay_kernel(
     pos_ref, dlen_ref, ilen_ref, start_ref,     # [CHUNK] SMEM op columns
     ol_ref, or_ref,                             # [CHUNK,B] VMEM outputs
@@ -97,9 +233,8 @@ def _replay_kernel(
     B = sig.shape[1]
     i = pl.program_id(0)
     last = pl.num_programs(0) - 1
-    idx_nb = lax.broadcasted_iota(jnp.int32, rws.shape, 0)
-    idx_k = lax.broadcasted_iota(jnp.int32, (K, B), 0)
-    idx_2k = lax.broadcasted_iota(jnp.int32, (2 * K, B), 0)
+    ops_ = _BlockOps(sig, rws, liv, tmp, err_ref, K=K, NB=NB, LMAX=LMAX)
+    idx_k = ops_.idx_k
     root_u = jnp.uint32(ROOT_ORDER)
 
     # Each grid step owns a fresh [CHUNK, B] origin-output block; rows for
@@ -116,114 +251,20 @@ def _replay_kernel(
         liv[:] = jnp.zeros_like(liv)
         err_ref[:] = jnp.zeros_like(err_ref)
 
-    def live_before_block(b):
-        return _lane_scalar(jnp.where(idx_nb < b, liv[:], 0))
-
-    def block_of_rank(rank1):
-        """Smallest block whose cumulative live count reaches ``rank1``
-        (the B-tree descent `root.rs:54-88` over block sums)."""
-        cumlive = _cumsum_rows(jnp.where(idx_nb < NB, liv[:], 0))
-        hits = (cumlive < rank1) & (idx_nb < NB)
-        return jnp.max(jnp.sum(hits.astype(jnp.int32), axis=0))
-
-    def rebalance():
-        """Compact all packed rows, redeal evenly (`mutations.rs:623-808`
-        analog). O(cap); triggered only on block overflow."""
-        total = _lane_scalar(jnp.where(idx_nb < NB, rws[:], 0))
-        fill = (total + NB - 1) // NB
-
-        @pl.when(fill > K - LMAX)
-        def _overflow():
-            err_ref[0:1, :] = jnp.ones((1, B), jnp.int32)
-
-        def compact(j, off):
-            rows_j = _lane_scalar(jnp.where(idx_nb == j, rws[:], 0))
-            tmp[pl.ds(off, K), :] = sig[pl.ds(j * K, K), :]
-            return off + rows_j
-
-        lax.fori_loop(0, NB, compact, 0)
-
-        def deal(j, _):
-            rows_j = jnp.clip(total - j * fill, 0, fill)
-            blk = tmp[pl.ds(j * fill, K), :]
-            nblk = jnp.where(idx_k < rows_j, blk, 0)
-            sig[pl.ds(j * K, K), :] = nblk
-            rws[pl.ds(j, 1), :] = jnp.broadcast_to(rows_j, (1, B))
-            liv[pl.ds(j, 1), :] = jnp.sum(
-                (nblk > 0).astype(jnp.int32), axis=0, keepdims=True)
-            return 0
-
-        lax.fori_loop(0, NB, deal, 0)
-
-    def do_delete(p, d):
-        """Tombstone ``d`` live chars after content pos ``p``
-        (`mutations.rs:520-570`); walks 2-block windows across the span."""
-
-        def body(carry):
-            rem, iters = carry
-            b = jnp.minimum(block_of_rank(p + 1), NB - 2)
-            base = live_before_block(b)
-            win = sig[pl.ds(b * K, 2 * K), :]
-            wlive = win > 0
-            rank = base + _cumsum_rows(wlive.astype(jnp.int32))
-            flip = wlive & (rank > p) & (rank <= p + rem)
-            sig[pl.ds(b * K, 2 * K), :] = jnp.where(flip, -win, win)
-            fcounts = flip.astype(jnp.int32)
-            f0 = _lane_scalar(jnp.where(idx_2k < K, fcounts, 0))
-            f1 = _lane_scalar(jnp.where(idx_2k >= K, fcounts, 0))
-            liv[pl.ds(b, 1), :] = liv[pl.ds(b, 1), :] - f0
-            liv[pl.ds(b + 1, 1), :] = liv[pl.ds(b + 1, 1), :] - f1
-            return rem - f0 - f1, iters + 1
-
-        # Iteration bound: each window contains >= 1 target char for a
-        # valid stream, so NB+1 windows means the delete ran off the
-        # document (invalid op) — flag instead of hanging the chip.
-        rem, iters = lax.while_loop(
-            lambda c: (c[0] > 0) & (c[1] <= NB), body, (d, 0))
-
-        @pl.when(rem > 0)
-        def _bad_delete():
-            err_ref[1:2, :] = jnp.ones((1, B), jnp.int32)
-
     def do_insert(k, p, il, st):
         """Splice ``il`` new items after live rank ``p`` into one block
         (`mutations.rs:17-179`; packed slack instead of node splits)."""
-
-        def target():
-            b = jnp.where(p == 0, 0, block_of_rank(p))
-            r0 = _lane_scalar(jnp.where(idx_nb == b, rws[:], 0))
-            return b, r0
-
-        b, r0 = target()
+        _, r0 = ops_.local_insert_block(p)
 
         @pl.when(r0 + il > K)
         def _rb():
-            rebalance()
+            ops_.rebalance()
 
-        b, r0 = target()
-        local_rank = p - live_before_block(b)
-        blk = sig[pl.ds(b * K, K), :]
-        bcum = _cumsum_rows((blk > 0).astype(jnp.int32))
-        c0 = jnp.max(jnp.sum(
-            (bcum < local_rank).astype(jnp.int32), axis=0))
-        c = jnp.where(p == 0, 0, c0 + 1)
-
-        # Origins (`doc.rs:447-453`): left = predecessor item; right = raw
-        # successor without skipping tombstones (`doc.rs:452-453`) — the
-        # pre-splice row c, or the first packed row of the next non-empty
-        # block when c is past this block's rows.
-        left_signed = _lane_scalar(jnp.where(idx_k == c - 1, blk, 0))
+        b, c, r0, left_signed, succ_signed = ops_.local_insert_target(p)
         left = jnp.where(p == 0, root_u, _order_of(left_signed))
-        succ_here = _lane_scalar(jnp.where(idx_k == c, blk, 0))
-        nb_next = jnp.max(jnp.min(jnp.where(
-            (idx_nb > b) & (idx_nb < NB) & (rws[:] > 0), idx_nb, NB),
-            axis=0))
-        nxt = sig[pl.ds(jnp.minimum(nb_next, NB - 1) * K, K), :]
-        succ_next = _lane_scalar(jnp.where(idx_k == 0, nxt, 0))
-        succ_signed = jnp.where(c < r0, succ_here,
-                                jnp.where(nb_next < NB, succ_next, 0))
         right = jnp.where(succ_signed == 0, root_u, _order_of(succ_signed))
 
+        blk = sig[pl.ds(b * K, K), :]
         shifted = _shift_rows(blk, il, LMAX)
         new_vals = st + (idx_k - c) + 1
         nblk = jnp.where(idx_k < c, blk,
@@ -243,7 +284,7 @@ def _replay_kernel(
 
         @pl.when(d > 0)
         def _():
-            do_delete(p, d)
+            ops_.local_delete(p, d)
 
         @pl.when(il > 0)
         def _():
@@ -289,6 +330,10 @@ class BlockedResult:
         if err[1].max() != 0:
             raise RuntimeError(
                 "delete ran past the end of the document (invalid op stream)")
+        if err[2].max() != 0:
+            raise RuntimeError(
+                "remote op referenced an order not present in the document "
+                "(bad origin or delete target)")
 
 
 def make_replayer(

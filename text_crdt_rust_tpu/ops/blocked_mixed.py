@@ -1,0 +1,467 @@
+"""Mixed-stream blocked Pallas engine: remote ops (hot path #2) in-kernel.
+
+``ops.blocked`` replays pure local-edit streams. This engine extends the
+same VMEM block layout to the full op surface — KIND_LOCAL,
+KIND_REMOTE_INS (YATA integrate, `doc.rs:167-234`), KIND_REMOTE_DEL
+(order-range tombstoning, `doc.rs:295-340`) — so an N-peer remote txn
+stream (the `BASELINE.json` config-4 concurrent-insert storm) replays on
+device in ONE kernel. The pieces the remote paths add:
+
+- **order -> block index** (``ordblk``): the SpaceIndex analog
+  (`split_list/mod.rs:440`, device twin of the `markers.rs:8` leaf
+  pointers). Maintained O(1) per insert (a run's orders are contiguous);
+  a rebalance moves rows between blocks and deliberately leaves the index
+  stale — lookups verify against the block and fall back to one
+  vectorized full-state search, then self-heal the entry. Amortized: the
+  fallback costs one O(capacity) compare, the same work class as a single
+  flat-engine step, and only fires on post-rebalance first touches.
+- **by-order origin/rank tables** in VMEM (``ol/or/rank``), prefilled
+  host-side (`batch.prefill_logs` values, packed 128 orders per row);
+  local inserts write the origins they discover at apply time, exactly
+  like the flat engine's log writes. The YATA scan reads these tables.
+- **remote insert**: cursor_after(origin_left) via the index, then the
+  reference's conflict scan as a ``lax.while_loop`` over raw positions
+  (zero iterations unless same-origin concurrent items exist,
+  `doc.rs:192-194`), then the shared splice.
+- **remote delete**: a bitmask walk over the (<= dmax-long) target order
+  run — each iteration resolves one not-yet-flipped order to its block,
+  flips EVERY in-range row in that block at once, and clears their bits;
+  already-deleted rows stay deleted (idempotent concurrent deletes,
+  `double_delete.rs:6-9`; excess counting stays host-side per SURVEY).
+
+Same lane batching as ``ops.blocked`` (all docs replay one shared
+stream), same result type, same ``blocked_to_flat`` conversion.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .batch import (
+    KIND_LOCAL,
+    KIND_REMOTE_DEL,
+    KIND_REMOTE_INS,
+    OpTensors,
+    prefill_logs,
+)
+from .blocked import (
+    BlockedResult,
+    _BlockOps,
+    _cumsum_rows,
+    _lane_scalar,
+    _require,
+    _shift_rows,
+)
+from .span_arrays import make_flat_doc
+
+LANES = 128  # orders per by-order table row
+
+
+def _mixed_kernel(
+    kind_ref, pos_ref, dlen_ref, dtgt_ref, olop_ref, orop_ref, rk_ref,
+    ilen_ref, start_ref,                        # [CHUNK] SMEM op columns
+    oll_in, orl_in, rkl_in,                     # [OT, 128] by-order tables
+    ol_ref, or_ref,                             # [CHUNK, B] outputs
+    sig_out_ref, rows_out_ref, err_ref,         # final state outputs
+    sig, rws, liv, tmp, ordblk, oll, orl,       # VMEM scratch
+    *, K: int, NB: int, CHUNK: int, LMAX: int, DMAX: int, OT: int,
+):
+    B = sig.shape[1]
+    CAP = K * NB
+    i = pl.program_id(0)
+    last = pl.num_programs(0) - 1
+    ops_ = _BlockOps(sig, rws, liv, tmp, err_ref, K=K, NB=NB, LMAX=LMAX)
+    idx_nb, idx_k = ops_.idx_nb, ops_.idx_k
+    idx_cap = lax.broadcasted_iota(jnp.int32, (CAP, B), 0)
+    lane = lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
+    lane2 = lax.broadcasted_iota(jnp.int32, (2, LANES), 1)
+    row2 = lax.broadcasted_iota(jnp.int32, (2, LANES), 0)
+    root_i = jnp.int32(-1)  # ROOT_ORDER as i32
+
+    ol_ref[:] = jnp.zeros_like(ol_ref)
+    or_ref[:] = jnp.zeros_like(or_ref)
+
+    @pl.when(i == 0)
+    def _init():
+        sig[:] = jnp.zeros_like(sig)
+        rws[:] = jnp.zeros_like(rws)
+        liv[:] = jnp.zeros_like(liv)
+        err_ref[:] = jnp.zeros_like(err_ref)
+        ordblk[:] = jnp.zeros_like(ordblk)
+        oll[:] = oll_in[:]
+        orl[:] = orl_in[:]
+
+    # ---- by-order tables (order o lives at [o // 128, o % 128]) ---------
+
+    def tab_read(tab, o):
+        r = tab[pl.ds(o // LANES, 1), :]
+        return jnp.sum(jnp.where(lane == o % LANES, r, 0))
+
+    def tab_write(tab, o, v):
+        r = tab[pl.ds(o // LANES, 1), :]
+        tab[pl.ds(o // LANES, 1), :] = jnp.where(lane == o % LANES, v, r)
+
+    def tab_write_run(tab, start, run_len, v):
+        """tab[start : start+run_len] = v; run_len <= LMAX <= 128, so a
+        2-row window always covers it (tables have a spare tail row)."""
+        r0 = start // LANES
+        w = tab[pl.ds(r0, 2), :]
+        g = row2 * LANES + lane2 + r0 * LANES
+        hit = (g >= start) & (g < start + run_len)
+        tab[pl.ds(r0, 2), :] = jnp.where(hit, v, w)
+
+    # ---- position plumbing ---------------------------------------------
+
+    def block_of_raw(c):
+        """Smallest block holding raw position c (c <= total raw);
+        clamped so an end-of-document cursor maps to the last block."""
+        cumraw = _cumsum_rows(jnp.where(idx_nb < NB, rws[:], 0))
+        hits = (cumraw <= c) & (idx_nb < NB)
+        return jnp.minimum(
+            jnp.max(jnp.sum(hits.astype(jnp.int32), axis=0)), NB - 1)
+
+    def item_at_raw(c):
+        """Signed row value at raw position c (c < total raw)."""
+        b = block_of_raw(c)
+        row = c - ops_.raw_before_block(b)
+        return _lane_scalar(jnp.where(idx_k == row, sig[pl.ds(b * K, K), :],
+                                      0))
+
+    def find_in_block(b, o):
+        """(found, row) of order o inside block b."""
+        blk = sig[pl.ds(b * K, K), :]
+        hit = (blk == o + 1) | (blk == -(o + 1))
+        found = _lane_scalar(hit.astype(jnp.int32)) > 0
+        row = jnp.max(jnp.min(jnp.where(hit, idx_k, K), axis=0))
+        return found, row
+
+    def locate_order(o):
+        """(block, row) of the item with order o. ordblk is a HINT — a
+        rebalance leaves it stale; verify, fall back to one vectorized
+        full-state search, and self-heal the entry."""
+        bh = jnp.clip(tab_read(ordblk, o), 0, NB - 1)
+        f, row = find_in_block(bh, o)
+
+        def fallback():
+            hit = (sig[:] == o + 1) | (sig[:] == -(o + 1))
+            g = jnp.max(jnp.min(jnp.where(hit, idx_cap, CAP), axis=0))
+            ok = _lane_scalar(hit.astype(jnp.int32)) > 0
+
+            @pl.when(~ok)
+            def _missing():
+                err_ref[2:3, :] = jnp.ones((1, B), jnp.int32)
+
+            return g // K, g % K
+
+        b, row = lax.cond(f, lambda: (bh, row), fallback)
+        tab_write(ordblk, o, b)
+        return b, row
+
+    def pos_of_order(o):
+        b, row = locate_order(o)
+        return ops_.raw_before_block(b) + row
+
+    def cursor_after(o):
+        return jnp.where(o == root_i, 0, pos_of_order(o) + 1)
+
+    # ---- shared splice (`mutations.rs:17-179` analog) -------------------
+    # Rebalances (ops_.rebalance) leave ordblk stale for every moved row;
+    # locate_order self-heals on the next touch.
+
+    def splice_at(b, c, k, il, st, left, right):
+        """Insert the run (orders st..st+il) at row c of block b, record
+        origins, and maintain the order index + origin tables."""
+        shifted = _shift_rows(sig[pl.ds(b * K, K), :], il, LMAX)
+        new_vals = st + (idx_k - c) + 1
+        blk = sig[pl.ds(b * K, K), :]
+        nblk = jnp.where(idx_k < c, blk,
+                         jnp.where(idx_k < c + il, new_vals, shifted))
+        sig[pl.ds(b * K, K), :] = nblk
+        rws[pl.ds(b, 1), :] = rws[pl.ds(b, 1), :] + il
+        liv[pl.ds(b, 1), :] = liv[pl.ds(b, 1), :] + il
+
+        tab_write_run(ordblk, st, il, b)
+        tab_write(oll, st, left)
+        tab_write_run(orl, st, il, right)
+
+        ol_ref[pl.ds(k, 1), :] = jnp.broadcast_to(left.astype(jnp.uint32),
+                                                  (1, B))
+        or_ref[pl.ds(k, 1), :] = jnp.broadcast_to(right.astype(jnp.uint32),
+                                                  (1, B))
+
+    # ---- local ops (shared _BlockOps, + index/table upkeep) -------------
+
+    def do_local_insert(k, p, il, st):
+        _, r0 = ops_.local_insert_block(p)
+
+        @pl.when(r0 + il > K)
+        def _rb():
+            ops_.rebalance()
+
+        b, c, r0, left_signed, succ_signed = ops_.local_insert_target(p)
+        left = jnp.where(p == 0, root_i, jnp.abs(left_signed) - 1)
+        right = jnp.where(succ_signed == 0, root_i,
+                          jnp.abs(succ_signed) - 1)
+        splice_at(b, c, k, il, st, left, right)
+
+    # ---- remote insert (`doc.rs:274-293` -> integrate) ------------------
+
+    def integrate_cursor(my_rank, o_left, o_right):
+        """The YATA conflict scan (`doc.rs:183-222`), pinned-scan_start
+        rule (see tests/test_integrate_divergence.py)."""
+        cursor0 = cursor_after(o_left)
+        left_cursor = cursor0
+        n = _lane_scalar(jnp.where(idx_nb < NB, rws[:], 0))
+
+        def cond(state):
+            cursor, scanning, scan_start, done = state
+            return ~done & (cursor < n)
+
+        def body(state):
+            cursor, scanning, scan_start, done = state
+            other_order = jnp.abs(item_at_raw(cursor)) - 1
+            other_left = tab_read(oll, other_order)
+            other_right = tab_read(orl, other_order)
+            other_rank = tab_read(rkl_in, other_order)
+            olc = cursor_after(other_left)
+            brk = (other_order == o_right) | (olc < left_cursor)
+            eq = ~brk & (olc == left_cursor)
+            gt = my_rank > other_rank
+            brk = brk | (eq & ~gt & (o_right == other_right))
+            starts_scan = eq & ~gt & (o_right != other_right)
+            new_scan_start = jnp.where(starts_scan & ~scanning, cursor,
+                                       scan_start)
+            new_scanning = jnp.where(
+                eq, jnp.where(gt, False, jnp.where(
+                    o_right == other_right, scanning, True)),
+                scanning,
+            )
+            return (jnp.where(brk, cursor, cursor + 1), new_scanning,
+                    new_scan_start, brk)
+
+        init = (cursor0, jnp.asarray(False), cursor0, jnp.asarray(False))
+        cursor, scanning, scan_start, _ = lax.while_loop(cond, body, init)
+        return jnp.where(scanning, scan_start, cursor)
+
+    def do_remote_insert(k, my_rank, o_left, o_right, il, st):
+        raw_cursor = integrate_cursor(my_rank, o_left, o_right)
+
+        def target():
+            b = block_of_raw(raw_cursor)
+            r0 = _lane_scalar(jnp.where(idx_nb == b, rws[:], 0))
+            return b, r0
+
+        b, r0 = target()
+
+        @pl.when(r0 + il > K)
+        def _rb():
+            ops_.rebalance()  # raw_cursor is invariant under a rebalance
+
+        b, r0 = target()
+        c = raw_cursor - ops_.raw_before_block(b)
+        splice_at(b, c, k, il, st, o_left, o_right)
+
+    # ---- remote delete (`doc.rs:295-340`) -------------------------------
+
+    def do_remote_delete(t, dlen):
+        """Tombstone orders [t, t+dlen). A bit in `mask` = a target order
+        not yet accounted for; each iteration resolves the lowest one to
+        its block and retires every in-range row found there."""
+        full = jnp.left_shift(jnp.int32(1), dlen) - 1
+
+        def body(carry):
+            mask, iters = carry
+            low = mask & (-mask)
+            k0 = lax.population_count(low - 1)
+            b, _row = locate_order(t + k0)
+            blk = sig[pl.ds(b * K, K), :]
+            occ = blk != 0
+            orders = jnp.abs(blk) - 1
+            diff = orders - t
+            in_range = occ & (diff >= 0) & (diff < dlen)
+            flip = in_range & (blk > 0)
+            sig[pl.ds(b * K, K), :] = jnp.where(flip, -blk, blk)
+            liv[pl.ds(b, 1), :] = (liv[pl.ds(b, 1), :]
+                                   - jnp.sum(flip.astype(jnp.int32), axis=0,
+                                             keepdims=True))
+            bits = _lane_scalar(jnp.where(
+                in_range,
+                jnp.left_shift(jnp.int32(1),
+                               jnp.clip(diff, 0, 30)), 0))
+            return mask & ~bits, iters + 1
+
+        mask, _ = lax.while_loop(
+            lambda c: (c[0] != 0) & (c[1] <= DMAX), body, (full, 0))
+
+        @pl.when(mask != 0)
+        def _bad():
+            err_ref[1:2, :] = jnp.ones((1, B), jnp.int32)
+
+    # ---- dispatch -------------------------------------------------------
+
+    def op_body(k, _):
+        kind = kind_ref[k]
+        p = pos_ref[k]
+        d = dlen_ref[k]
+        il = ilen_ref[k]
+        st = start_ref[k]
+
+        @pl.when((kind == KIND_LOCAL) & (d > 0))
+        def _():
+            ops_.local_delete(p, d)
+
+        @pl.when((kind == KIND_LOCAL) & (il > 0))
+        def _():
+            do_local_insert(k, p, il, st)
+
+        @pl.when((kind == KIND_REMOTE_INS) & (il > 0))
+        def _():
+            do_remote_insert(k, rk_ref[k], olop_ref[k], orop_ref[k], il, st)
+
+        @pl.when(kind == KIND_REMOTE_DEL)
+        def _():
+            do_remote_delete(dtgt_ref[k], d)
+
+        return 0
+
+    lax.fori_loop(0, CHUNK, op_body, 0)
+
+    @pl.when(i == last)
+    def _flush():
+        sig_out_ref[:] = sig[:]
+        rows_out_ref[:] = rws[:]
+
+
+def make_replayer_mixed(
+    ops: OpTensors,
+    capacity: int,
+    batch: int = 128,
+    block_k: int = 256,
+    chunk: int = 1024,
+    interpret: bool = False,
+):
+    """Stage a mixed local/remote op stream and build a jitted replayer.
+
+    Same contract as ``blocked.make_replayer`` but accepts every op kind.
+    Remote delete runs must be pre-chunked to <= 16 targets per step
+    (``compile_remote_txns(..., dmax=16)``).
+    """
+    kinds = np.asarray(ops.kind)
+    _require(kinds.ndim == 1, "blocked engine takes one shared stream")
+    _require(capacity % block_k == 0,
+             f"capacity ({capacity}) must be a multiple of block_k "
+             f"({block_k})")
+    _require(interpret or chunk % 1024 == 0 or (
+        jax.default_backend() != "tpu"),
+        "chunk must be a multiple of 1024 on TPU")
+    NB = capacity // block_k
+    _require(NB >= 2, "need at least two blocks (delete window)")
+    NBp = max(8, NB)
+    lmax = ops.lmax
+    _require(block_k > lmax, (
+        f"block_k ({block_k}) must exceed the insert chunk width ({lmax})"))
+    dlens = np.asarray(ops.del_len)[kinds == KIND_REMOTE_DEL]
+    dmax = 16
+    _require(dlens.size == 0 or int(dlens.max()) <= dmax, (
+        f"remote delete runs must be <= {dmax} targets per step "
+        f"(compile with dmax={dmax})"))
+    rows_needed = int(np.asarray(ops.ins_len, dtype=np.int64).sum())
+    rows_limit = NB * (block_k - lmax)
+    _require(rows_needed <= rows_limit, (
+        f"stream inserts {rows_needed} rows but {NB} blocks of "
+        f"{block_k} hold at most {rows_limit} at the rebalance fill "
+        f"limit (K-lmax); raise capacity"))
+
+    # By-order tables: everything the compiler knows (remote origins,
+    # within-run chains, ranks), packed 128 orders per row, i32 (ROOT ->
+    # -1 by u32 wraparound). One spare tail row for the 2-row run writes.
+    total_orders = int(np.asarray(ops.order_advance, dtype=np.int64).sum())
+    ocap = max(total_orders + lmax, LANES)
+    OT = (ocap + LANES - 1) // LANES + 1
+    OT = ((OT + 7) // 8) * 8
+    doc0 = prefill_logs(make_flat_doc(8, OT * LANES), ops)
+
+    def table(x):
+        return jnp.asarray(
+            np.asarray(x, dtype=np.uint32).view(np.int32).reshape(OT, LANES))
+
+    oll0 = table(doc0.ol_log)
+    orl0 = table(doc0.or_log)
+    rkl0 = table(doc0.rank_log)
+
+    s = ops.num_steps
+    s_pad = max(((s + chunk - 1) // chunk) * chunk, chunk)
+    pad = ((0, s_pad - s),)
+
+    def padded(a):
+        return jnp.asarray(np.pad(
+            np.asarray(a, dtype=np.uint32).view(np.int32), pad))
+
+    staged = tuple(padded(c) for c in (
+        ops.kind, ops.pos, ops.del_len, ops.del_target, ops.origin_left,
+        ops.origin_right, ops.rank, ops.ins_len, ops.ins_order_start))
+
+    smem = lambda: pl.BlockSpec(
+        (chunk,), lambda i: (i,), memory_space=pltpu.SMEM)
+
+    def whole(shape):
+        return pl.BlockSpec(shape, lambda i: tuple(0 for _ in shape),
+                            memory_space=pltpu.VMEM)
+
+    call = pl.pallas_call(
+        partial(_mixed_kernel, K=block_k, NB=NB, CHUNK=chunk, LMAX=lmax,
+                DMAX=dmax, OT=OT),
+        grid=(s_pad // chunk,),
+        in_specs=[smem() for _ in range(9)] + [
+            whole((OT, LANES)), whole((OT, LANES)), whole((OT, LANES))],
+        out_specs=[
+            pl.BlockSpec((chunk, batch), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((chunk, batch), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            whole((capacity, batch)),
+            whole((NBp, batch)),
+            whole((8, batch)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((s_pad, batch), jnp.uint32),
+            jax.ShapeDtypeStruct((s_pad, batch), jnp.uint32),
+            jax.ShapeDtypeStruct((capacity, batch), jnp.int32),
+            jax.ShapeDtypeStruct((NBp, batch), jnp.int32),
+            jax.ShapeDtypeStruct((8, batch), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((capacity, batch), jnp.int32),
+            pltpu.VMEM((NBp, batch), jnp.int32),
+            pltpu.VMEM((NBp, batch), jnp.int32),
+            pltpu.VMEM((capacity + block_k, batch), jnp.int32),
+            pltpu.VMEM((OT, LANES), jnp.int32),   # ordblk
+            pltpu.VMEM((OT, LANES), jnp.int32),   # ol table
+            pltpu.VMEM((OT, LANES), jnp.int32),   # or table
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )
+    jitted = jax.jit(lambda *a: call(*a))
+    tables = (oll0, orl0, rkl0)
+
+    def run() -> BlockedResult:
+        ol, orr, signed, rows, err = jitted(*staged, *tables)
+        return BlockedResult(
+            signed=signed, rows=rows, ol=ol[:s], orr=orr[:s], err=err,
+            block_k=block_k, num_blocks=NB, batch=batch)
+
+    return run
+
+
+def replay_mixed(ops: OpTensors, capacity: int, **kw) -> BlockedResult:
+    """One-shot convenience wrapper over ``make_replayer_mixed``."""
+    return make_replayer_mixed(ops, capacity, **kw)()
