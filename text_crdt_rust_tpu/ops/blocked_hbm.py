@@ -48,16 +48,18 @@ SUP = 64  # blocks per super-block (level-2 index fan-out)
 
 
 def _hbm_replay_kernel(
-    pos_ref, dlen_ref, ilen_ref, start_ref,     # [CHUNK] SMEM op columns
-    ol_ref, or_ref,                             # [CHUNK,B] VMEM outputs
-    state_ref, tmp_ref,                         # [CAP(+K),B] ANY/HBM state
+    pos_ref, dlen_ref, ilen_ref, start_ref,     # [1,CHUNK] SMEM op columns
+    ol_ref, or_ref,                             # [1,CHUNK,B] VMEM outputs
+    state_ref, tmp_ref,                         # [G*CAP(+K),B] ANY/HBM state
     rows_out_ref, err_ref,                      # final outputs
     win, stage, rws, liv, supliv, wmeta, sem,   # scratch
     *, K: int, NB: int, NSUP: int, CHUNK: int, LMAX: int,
 ):
     B = win.shape[1]
-    i = pl.program_id(0)
-    last = pl.num_programs(0) - 1
+    g = pl.program_id(0)        # doc group: its own stream + state slab
+    i = pl.program_id(1)        # op chunk within the group
+    last = pl.num_programs(1) - 1
+    base = g * (NB * K)         # group g's row offset into the HBM state
     idx_nb = lax.broadcasted_iota(jnp.int32, rws.shape, 0)
     idx_sup = lax.broadcasted_iota(jnp.int32, supliv.shape, 0)
     idx_k = lax.broadcasted_iota(jnp.int32, (K, B), 0)
@@ -66,13 +68,13 @@ def _hbm_replay_kernel(
 
     def dma_out(cb):
         cp = pltpu.make_async_copy(
-            win, state_ref.at[pl.ds(cb * K, 2 * K), :], sem)
+            win, state_ref.at[pl.ds(base + cb * K, 2 * K), :], sem)
         cp.start()
         cp.wait()
 
     def dma_in(b):
         cp = pltpu.make_async_copy(
-            state_ref.at[pl.ds(b * K, 2 * K), :], win, sem)
+            state_ref.at[pl.ds(base + b * K, 2 * K), :], win, sem)
         cp.start()
         cp.wait()
 
@@ -90,17 +92,21 @@ def _hbm_replay_kernel(
     ol_ref[:] = jnp.zeros_like(ol_ref)
     or_ref[:] = jnp.zeros_like(or_ref)
 
+    @pl.when((g == 0) & (i == 0))
+    def _init_err():
+        err_ref[:] = jnp.zeros_like(err_ref)
+
     @pl.when(i == 0)
     def _init():
+        # Fresh group: zero the per-group scratch and this group's slab.
         rws[:] = jnp.zeros_like(rws)
         liv[:] = jnp.zeros_like(liv)
         supliv[:] = jnp.zeros_like(supliv)
-        err_ref[:] = jnp.zeros_like(err_ref)
         win[:] = jnp.zeros_like(win)
 
         def zero_blk(j, _):
             cp = pltpu.make_async_copy(
-                win, state_ref.at[pl.ds(j * 2 * K, 2 * K), :], sem)
+                win, state_ref.at[pl.ds(base + j * 2 * K, 2 * K), :], sem)
             cp.start()
             cp.wait()
             return 0
@@ -155,7 +161,7 @@ def _hbm_replay_kernel(
         def compact(j, off):
             rows_j = _lane_scalar(jnp.where(idx_nb == j, rws[:], 0))
             cp = pltpu.make_async_copy(
-                state_ref.at[pl.ds(j * K, K), :],
+                state_ref.at[pl.ds(base + j * K, K), :],
                 tmp_ref.at[pl.ds(off, K), :], sem)
             cp.start()
             cp.wait()
@@ -172,7 +178,7 @@ def _hbm_replay_kernel(
             nblk = jnp.where(idx_k < rows_j, stage[:], 0)
             stage[:] = nblk
             cp = pltpu.make_async_copy(
-                stage, state_ref.at[pl.ds(j * K, K), :], sem)
+                stage, state_ref.at[pl.ds(base + j * K, K), :], sem)
             cp.start()
             cp.wait()
             rws[pl.ds(j, 1), :] = jnp.broadcast_to(rows_j, (1, B))
@@ -268,7 +274,7 @@ def _hbm_replay_kernel(
 
             def from_hbm():
                 cp = pltpu.make_async_copy(
-                    state_ref.at[pl.ds(nxt * K, K), :], stage, sem)
+                    state_ref.at[pl.ds(base + nxt * K, K), :], stage, sem)
                 cp.start()
                 cp.wait()
                 return _lane_scalar(jnp.where(idx_k == 0, stage[:], 0))
@@ -287,14 +293,14 @@ def _hbm_replay_kernel(
         win[pl.ds(half * K, K), :] = nblk
         bump(b, il, il)
 
-        ol_ref[pl.ds(k, 1), :] = jnp.broadcast_to(left, (1, B))
-        or_ref[pl.ds(k, 1), :] = jnp.broadcast_to(right, (1, B))
+        ol_ref[:, pl.ds(k, 1), :] = jnp.broadcast_to(left, (1, 1, B))
+        or_ref[:, pl.ds(k, 1), :] = jnp.broadcast_to(right, (1, 1, B))
 
     def op_body(k, _):
-        p = pos_ref[k]
-        d = dlen_ref[k]
-        il = ilen_ref[k]
-        st = start_ref[k]
+        p = pos_ref[0, k]
+        d = dlen_ref[0, k]
+        il = ilen_ref[0, k]
+        st = start_ref[0, k]
 
         @pl.when(d > 0)
         def _():
@@ -311,22 +317,43 @@ def _hbm_replay_kernel(
     @pl.when(i == last)
     def _flush():
         dma_out(wmeta[0])
-        rows_out_ref[:] = rws[:]
+        rows_out_ref[:] = rws[:][jnp.newaxis]
 
 
 def make_replayer_hbm(
-    ops: OpTensors,
+    ops,
     capacity: int,
     batch: int = 128,
     block_k: int = 512,
     chunk: int = 1024,
     interpret: bool = False,
 ):
-    """HBM-state variant of ``blocked.make_replayer`` (same contract)."""
-    kinds = np.asarray(ops.kind)
-    _require(kinds.ndim == 1, "blocked engine takes one shared stream")
-    _require(bool((kinds == KIND_LOCAL).all()),
-             "blocked engine replays local streams; remote ops -> ops.flat")
+    """HBM-state variant of ``blocked.make_replayer``.
+
+    ``ops`` is one ``OpTensors`` stream (same contract as the VMEM
+    engine: returns ``run() -> BlockedResult``) or a SEQUENCE of streams
+    — doc GROUPS. Groups ride an extra leading grid dimension: each gets
+    its own op stream, its own ``capacity``-row slab of the HBM state,
+    and its own init/flush boundary, while lanes still batch ``batch``
+    identical docs per group. This is the config-3 "ragged mixed corpus"
+    shape (SURVEY §2 segmented/ragged execution): divergent per-group
+    streams in ONE kernel launch, with no lockstep waste beyond padding
+    to the longest stream. For grouped input ``run()`` returns a list of
+    per-group ``BlockedResult``.
+    """
+    grouped = isinstance(ops, (list, tuple))
+    streams = list(ops) if grouped else [ops]
+    G = len(streams)
+    _require(G >= 1, "need at least one op stream")
+    lmax = streams[0].lmax
+    for st in streams:
+        kinds = np.asarray(st.kind)
+        _require(kinds.ndim == 1, "blocked engine takes per-group shared "
+                 "streams (no per-lane batching inside a group)")
+        _require(bool((kinds == KIND_LOCAL).all()),
+                 "hbm engine replays local streams; remote ops -> "
+                 "ops.blocked_mixed / ops.flat")
+        _require(st.lmax == lmax, "all groups must share one lmax")
     _require(capacity % block_k == 0,
              f"capacity ({capacity}) must be a multiple of block_k "
              f"({block_k})")
@@ -342,31 +369,36 @@ def make_replayer_hbm(
     # partial super-block once content reaches it.
     NBp = NSUP * SUP
     NSUPp = max(8, ((NSUP + 7) // 8) * 8)
-    lmax = ops.lmax
     _require(block_k > lmax, (
         f"block_k ({block_k}) must exceed the insert chunk width ({lmax})"))
-    rows_needed = int(np.asarray(ops.ins_len, dtype=np.int64).sum())
     rows_limit = NB * (block_k - lmax)
-    _require(rows_needed <= rows_limit, (
-        f"stream inserts {rows_needed} rows but {NB} blocks of "
-        f"{block_k} hold at most {rows_limit} at the rebalance fill "
-        f"limit (K-lmax); raise capacity"))
+    for gi, st in enumerate(streams):
+        rows_needed = int(np.asarray(st.ins_len, dtype=np.int64).sum())
+        _require(rows_needed <= rows_limit, (
+            f"group {gi} inserts {rows_needed} rows but {NB} blocks of "
+            f"{block_k} hold at most {rows_limit} at the rebalance fill "
+            f"limit (K-lmax); raise capacity"))
 
-    s = ops.num_steps
-    s_pad = max(((s + chunk - 1) // chunk) * chunk, chunk)
-    pad = ((0, s_pad - s),)
+    lens = [st.num_steps for st in streams]
+    s_pad = max(((max(lens) + chunk - 1) // chunk) * chunk, chunk)
 
-    def padded(a):
-        return jnp.asarray(np.pad(np.asarray(a, dtype=np.int32), pad))
+    def staged_col(get):
+        cols = []
+        for st in streams:
+            a = np.asarray(get(st), dtype=np.int32)
+            cols.append(np.pad(a, ((0, s_pad - len(a)),)))
+        return jnp.asarray(np.stack(cols))          # [G, s_pad]
 
-    staged = (padded(ops.pos), padded(ops.del_len), padded(ops.ins_len),
-              padded(ops.ins_order_start))
+    staged = (staged_col(lambda o: o.pos),
+              staged_col(lambda o: o.del_len),
+              staged_col(lambda o: o.ins_len),
+              staged_col(lambda o: o.ins_order_start))
 
     smem = lambda: pl.BlockSpec(
-        (chunk,), lambda i: (i,), memory_space=pltpu.SMEM)
+        (1, chunk), lambda g, i: (g, i), memory_space=pltpu.SMEM)
 
     def whole_vmem(shape):
-        return pl.BlockSpec(shape, lambda i: tuple(0 for _ in shape),
+        return pl.BlockSpec(shape, lambda g, i: tuple(0 for _ in shape),
                             memory_space=pltpu.VMEM)
 
     def whole_any(shape):
@@ -376,24 +408,25 @@ def make_replayer_hbm(
     call = pl.pallas_call(
         partial(_hbm_replay_kernel, K=block_k, NB=NB, NSUP=NSUP,
                 CHUNK=chunk, LMAX=lmax),
-        grid=(s_pad // chunk,),
+        grid=(G, s_pad // chunk),
         in_specs=[smem(), smem(), smem(), smem()],
         out_specs=[
-            pl.BlockSpec((chunk, batch), lambda i: (i, 0),
+            pl.BlockSpec((1, chunk, batch), lambda g, i: (g, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((chunk, batch), lambda i: (i, 0),
+            pl.BlockSpec((1, chunk, batch), lambda g, i: (g, i, 0),
                          memory_space=pltpu.VMEM),
-            whole_any((capacity, batch)),
+            whole_any((G * capacity, batch)),
             whole_any((capacity + block_k, batch)),
-            whole_vmem((NBp, batch)),
+            pl.BlockSpec((1, NBp, batch), lambda g, i: (g, 0, 0),
+                         memory_space=pltpu.VMEM),
             whole_vmem((8, batch)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((s_pad, batch), jnp.uint32),
-            jax.ShapeDtypeStruct((s_pad, batch), jnp.uint32),
-            jax.ShapeDtypeStruct((capacity, batch), jnp.int32),
+            jax.ShapeDtypeStruct((G, s_pad, batch), jnp.uint32),
+            jax.ShapeDtypeStruct((G, s_pad, batch), jnp.uint32),
+            jax.ShapeDtypeStruct((G * capacity, batch), jnp.int32),
             jax.ShapeDtypeStruct((capacity + block_k, batch), jnp.int32),
-            jax.ShapeDtypeStruct((NBp, batch), jnp.int32),
+            jax.ShapeDtypeStruct((G, NBp, batch), jnp.int32),
             jax.ShapeDtypeStruct((8, batch), jnp.int32),
         ],
         scratch_shapes=[
@@ -412,15 +445,20 @@ def make_replayer_hbm(
     )
     jitted = jax.jit(lambda a, b, c, d: call(a, b, c, d))
 
-    def run() -> BlockedResult:
+    def run():
         ol, orr, state, _tmp, rows, err = jitted(*staged)
-        return BlockedResult(
-            signed=state, rows=rows, ol=ol[:s], orr=orr[:s], err=err,
-            block_k=block_k, num_blocks=NB, batch=batch)
+        results = [
+            BlockedResult(
+                signed=state[gi * capacity:(gi + 1) * capacity],
+                rows=rows[gi], ol=ol[gi, :lens[gi]], orr=orr[gi, :lens[gi]],
+                err=err, block_k=block_k, num_blocks=NB, batch=batch)
+            for gi in range(G)
+        ]
+        return results if grouped else results[0]
 
     return run
 
 
-def replay_local_hbm(ops: OpTensors, capacity: int, **kw) -> BlockedResult:
+def replay_local_hbm(ops, capacity: int, **kw):
     """One-shot convenience wrapper over ``make_replayer_hbm``."""
     return make_replayer_hbm(ops, capacity, **kw)()
