@@ -1,22 +1,38 @@
-"""North-star benchmark: automerge-paper replay tiled across a doc batch.
+"""Benchmark suite: the five BASELINE configs + kevin, on real TPU.
 
-Replays the automerge-paper editing trace — by default the FULL 259,778
-patches, the `benches/yjs.rs:32-49` workload with its final-content
-assertion (`yjs.rs:46`) — across ``--batch`` identical documents on a
-device engine. Reports aggregate CRDT ops/sec/chip.
+Default run = the NORTH STAR: the full automerge-paper trace
+(`benches/yjs.rs:32-49`, final-content asserted) tiled across ``--batch``
+identical documents on the HBM blocked engine. ``--config all`` runs the
+whole BASELINE.json table and writes it to ``BENCH_ALL.json``:
 
-``vs_baseline`` is an EQUAL-WORKLOAD ratio: the native C++ engine
-(``models.native``, the CPU reference stand-in) replays the *same* patch
-list single-core at bench time, so the denominator always matches the
-numerator's workload (full trace or ``--patches`` prefix).
+1. automerge-paper single-doc replay — the CPU reference path (our
+   native C++ engine), plus the TPU north-star row.
+2. ``random_edits`` workload, identical docs batched in the lane dim.
+3. ragged mixed corpus (rustcode + sveltecomponent) — divergent doc
+   GROUPS on the HBM engine's grid dimension.
+4. N-peer concurrent-insert storm (tiebreak-heavy) — remote ops on the
+   mixed blocked engine.
+5. streaming apply, delete-heavy, per-doc divergent streams on the flat
+   engine with periodic host<->device checkpoint resync.
+kevin: 5M single-char prepends (`benches/yjs.rs:51-62`) on the native
+   engine; the TPU row runs a reduced, honestly-labeled prefix (the
+   global-rebalance design degrades on the pure-prepend worst case).
 
-Prints exactly ONE JSON line on stdout; everything else goes to stderr.
+Every row reports ops/sec/chip, p50 per-step latency, HBM bytes, an
+oracle-equality flag, and an EQUAL-WORKLOAD ``vs_baseline`` (the native
+C++ engine replays the same logical workload single-core at bench time).
+
+Prints exactly ONE JSON line (the north-star row) on stdout; everything
+else goes to stderr / BENCH_ALL.json.
 """
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import random
 import sys
+import tempfile
 import time
 from functools import partial
 
@@ -26,7 +42,9 @@ import numpy as np
 from text_crdt_rust_tpu.ops import batch as B
 from text_crdt_rust_tpu.ops import flat as F
 from text_crdt_rust_tpu.ops import span_arrays as SA
+from text_crdt_rust_tpu.utils.randedit import make_storm, random_patches
 from text_crdt_rust_tpu.utils.testdata import (
+    TestPatch,
     flatten_patches,
     load_testing_data,
     trace_path,
@@ -44,9 +62,12 @@ def expected_content(patches) -> str:
     return s
 
 
-def measure_cpu_baseline(patches, reps: int = 3) -> float:
-    """Single-core ops/s of the native C++ engine on the SAME workload
-    (fills the BASELINE.md row at bench time; best of ``reps``)."""
+# ---------------------------------------------------------------- native --
+
+
+def native_replay(patches, reps: int = 3):
+    """(ops/s, final_string) of the native C++ engine on a local-edit
+    patch list, single core, best of ``reps``."""
     from text_crdt_rust_tpu.models.native import NativeListCRDT
 
     pos = [p.pos for p in patches]
@@ -62,153 +83,82 @@ def measure_cpu_baseline(patches, reps: int = 3) -> float:
         t0 = time.perf_counter()
         doc.replay_trace(agent, pos, dels, ilens, cps)
         best = min(best, time.perf_counter() - t0)
-    want = expected_content(patches)
-    got = doc.to_string()
-    assert got == want, "native baseline replay diverged from string oracle"
-    return len(patches) / best
+    return len(patches) / best, doc.to_string()
 
 
-def emit(n_ops, batch, wall, steps, hbm_bytes, baseline_ops, extra=None):
-    total_ops = n_ops * batch
-    ops_per_sec = total_ops / wall
-    log(f"wall {wall:.3f}s/run, {total_ops} ops -> {ops_per_sec:,.0f} ops/s "
-        f"(baseline {baseline_ops:,.0f} ops/s single-core, same workload)")
+def native_remote_replay(txns, reps: int = 3):
+    """(txns-ops/s, final_string) for a RemoteTxn stream on the native
+    engine (hot path #2, `doc.rs:242-348`), single core."""
+    from text_crdt_rust_tpu.models.native import NativeListCRDT
+
+    n_ops = sum(sum(getattr(op, "len", len(getattr(op, "ins_content", "")))
+                    for op in t.ops) for t in txns)
+    best = float("inf")
+    for _ in range(reps):
+        doc = NativeListCRDT()
+        t0 = time.perf_counter()
+        for t in txns:
+            doc.apply_remote_txn(t)
+        best = min(best, time.perf_counter() - t0)
+    return n_ops / best, doc.to_string()
+
+
+# ------------------------------------------------------------------ rows --
+
+
+def make_row(config, engine, n_ops, batch, wall, steps, hbm_bytes,
+             base_ops, oracle_equal, **extra):
+    total = n_ops * batch
+    ops_per_sec = total / wall
     row = {
+        "config": config,
+        "engine": engine,
         "metric": "crdt_ops_per_sec_chip",
         "value": round(ops_per_sec, 1),
         "unit": "ops/s",
-        "vs_baseline": round(ops_per_sec / baseline_ops, 3),
+        "vs_baseline": round(ops_per_sec / base_ops, 3) if base_ops else None,
+        "baseline_ops_per_sec": round(base_ops, 1) if base_ops else None,
         "p50_step_latency_us": round(wall / steps * 1e6, 3),
         "hbm_bytes": int(hbm_bytes),
         "ops": int(n_ops),
         "batch": int(batch),
+        "oracle_equal": bool(oracle_equal),
     }
-    if extra:
-        row.update(extra)
-    print(json.dumps(row))
+    row.update(extra)
+    log(f"[{config}] {ops_per_sec:,.0f} ops/s "
+        f"(x{row['vs_baseline']} vs native single-core), "
+        f"oracle_equal={oracle_equal}")
+    return row
 
 
-def bench_blocked(args, ops, patches, n_ops, capacity, baseline_ops) -> None:
-    """One-kernel blocked replay: docs ride the lane dimension (batch in
-    units of 128 lanes). ``--engine blocked`` holds the document in VMEM
-    (caps near ~50k rows); ``--engine hbm`` keeps state in HBM with a
-    DMA'd VMEM window, so the FULL trace fits. Timed over several runs —
-    device round-trip latency on the tunneled chip (~70ms) would otherwise
-    swamp the kernel."""
-    from text_crdt_rust_tpu.ops import blocked as BL
-    from text_crdt_rust_tpu.ops import blocked_hbm as BH
-
-    batch = max(128, (args.batch // 128) * 128)
-    # Headroom: rebalance degrades as fill -> K-lmax; 2x keeps fill <= K/2.
-    cap = capacity * 2
-    block_k = min(args.block_k, cap // 2)  # small prefixes: >= 2 blocks
-    log(f"{args.engine} engine: batch {batch} (128-lane units), "
-        f"capacity {cap}, block_k {block_k}")
-    if args.engine == "hbm":
-        run = BH.make_replayer_hbm(
-            ops, capacity=cap, batch=batch,
-            block_k=block_k, chunk=args.chunk, interpret=args.interpret)
-        # state + tmp (HBM-resident) + origin outputs
-        hbm_bytes = (2 * cap + block_k) * batch * 4 \
-            + 2 * ops.num_steps * batch * 4
-    else:
-        run = BL.make_replayer(
-            ops, capacity=cap, batch=batch,
-            block_k=block_k, chunk=args.chunk, interpret=args.interpret)
-        hbm_bytes = cap * batch * 4 + 2 * ops.num_steps * batch * 4
-
-    log("compiling...")
+def time_run(run, reps):
     t0 = time.perf_counter()
     res = run()
-    res.check()  # forces completion
-    log(f"first run (incl. compile): {time.perf_counter() - t0:.2f}s")
-
-    reps = args.reps
+    first = time.perf_counter() - t0
+    log(f"  first run (incl. compile): {first:.2f}s")
     t0 = time.perf_counter()
     for _ in range(reps):
         res = run()
-    res.check()
+    _force(res)
     wall = (time.perf_counter() - t0) / reps
-
-    want = expected_content(patches)
-    doc = BL.blocked_to_flat(ops, res)
-    got = SA.to_string(doc)
-    assert got == want, f"{args.engine} replay diverged from string oracle"
-
-    emit(n_ops, batch, wall, ops.num_steps, hbm_bytes, baseline_ops,
-         extra={"engine": args.engine, "reps": reps})
+    return res, wall
 
 
-def bench_flat(args, ops, patches, n_ops, capacity, baseline_ops) -> None:
-    # Identical docs share one op stream: vmap with in_axes=None keeps the
-    # uploaded stream at [S, ...] (no host-side tiling, ~MBs not GBs). The
-    # stream is pure local edits, so the remote paths compile out.
-    vstep = jax.vmap(partial(F.step, local_only=True), in_axes=(0, None))
-
-    @jax.jit
-    def replay(docs, ops):
-        def body(d, op):
-            return vstep(d, op), None
-
-        out, _ = jax.lax.scan(body, docs, ops)
-        return out
-
-    base = B.prefill_logs(SA.make_flat_doc(capacity), ops)
-    F._check_capacity(base, ops)
-    docs = SA.stack_docs(base, args.batch)
-    ops = jax.device_put(ops)
-    docs = jax.device_put(docs)
-
-    log("compiling...")
-    t0 = time.perf_counter()
-    out = replay(docs, ops)
-    jax.block_until_ready(out)
-    log(f"first run (incl. compile): {time.perf_counter() - t0:.2f}s")
-
-    t0 = time.perf_counter()
-    out = replay(docs, ops)
-    jax.block_until_ready(out)
-    wall = time.perf_counter() - t0
-
-    # Correctness: every doc must equal the plain-string replay
-    # (`benches/yjs.rs:46` asserts final length each iteration).
-    want = expected_content(patches)
-    got = SA.to_string(jax.tree.map(lambda x: x[0], out))
-    assert got == want, "device replay diverged from string oracle"
-    assert int(np.asarray(out.n).min()) == int(np.asarray(out.n).max())
-
-    hbm_bytes = sum(
-        np.asarray(x).nbytes for x in jax.tree.leaves(docs))
-    emit(n_ops, args.batch, wall, ops.num_steps, hbm_bytes, baseline_ops,
-         extra={"engine": "flat"})
+def _force(res):
+    if isinstance(res, list):
+        for r in res:
+            r.check()
+    else:
+        res.check()
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--trace", default="automerge-paper")
-    ap.add_argument("--patches", type=int, default=0,
-                    help="trace prefix length (0 = FULL trace, the "
-                         "`benches/yjs.rs` workload)")
-    ap.add_argument("--batch", type=int, default=128)
-    ap.add_argument("--lmax", type=int, default=16)
-    ap.add_argument("--engine", choices=("flat", "blocked", "hbm"),
-                    default="hbm")
-    ap.add_argument("--block-k", type=int, default=512)
-    ap.add_argument("--chunk", type=int, default=1024)
-    ap.add_argument("--reps", type=int, default=5)
-    ap.add_argument("--cpu", action="store_true",
-                    help="force the CPU backend (logic check, not a perf "
-                         "number; implies --interpret for blocked/hbm)")
-    ap.add_argument("--interpret", action="store_true",
-                    help="run Pallas kernels in interpreter mode")
-    args = ap.parse_args()
+# --------------------------------------------------------------- configs --
 
-    if args.cpu:
-        jax.config.update("jax_platforms", "cpu")
-        args.interpret = True
 
-    dev = jax.devices()[0]
-    log(f"device: {dev.platform} {dev.device_kind}")
+def cfg_northstar(args):
+    """Full automerge-paper trace x batch identical docs (HBM engine)."""
+    from text_crdt_rust_tpu.ops import blocked as BL
+    from text_crdt_rust_tpu.ops import blocked_hbm as BH
 
     data = load_testing_data(trace_path(args.trace))
     patches = flatten_patches(data)
@@ -216,21 +166,357 @@ def main() -> None:
         patches = patches[:args.patches]
     n_ops = len(patches)
     ins_total = sum(len(p.ins_content) for p in patches)
-    capacity = 1 << int(np.ceil(np.log2(max(ins_total, 64))))
-    dmax = args.lmax if args.engine in ("blocked", "hbm") else None
-    ops, _ = B.compile_local_patches(patches, lmax=args.lmax, dmax=dmax)
-    steps = ops.num_steps
-    log(f"{args.trace}[:{n_ops}] -> {steps} device steps, "
-        f"capacity {capacity}, batch {args.batch}")
+    capacity = 2 << int(np.ceil(np.log2(max(ins_total, 64))))
+    ops, _ = B.compile_local_patches(patches, lmax=args.lmax, dmax=args.lmax)
+    batch = args.batch
+    block_k = min(args.block_k, capacity // 2)
+    log(f"[northstar] {args.trace}[:{n_ops}] -> {ops.num_steps} steps, "
+        f"capacity {capacity}, batch {batch}, engine {args.engine}")
 
-    log("measuring single-core CPU baseline on the same workload...")
-    baseline_ops = measure_cpu_baseline(patches)
-    log(f"native C++ single-core: {baseline_ops:,.0f} ops/s")
+    base_ops, base_str = native_replay(patches)
+    want = expected_content(patches)
+    assert base_str == want
 
-    if args.engine in ("blocked", "hbm"):
-        return bench_blocked(args, ops, patches, n_ops, capacity,
-                             baseline_ops)
-    return bench_flat(args, ops, patches, n_ops, capacity, baseline_ops)
+    if args.engine == "hbm":
+        run = BH.make_replayer_hbm(ops, capacity=capacity, batch=batch,
+                                   block_k=block_k, chunk=args.chunk,
+                                   interpret=args.interpret)
+        hbm = (2 * capacity + block_k) * batch * 4 \
+            + 2 * ops.num_steps * batch * 4
+    else:
+        run = BL.make_replayer(ops, capacity=capacity, batch=batch,
+                               block_k=block_k, chunk=args.chunk,
+                               interpret=args.interpret)
+        hbm = capacity * batch * 4 + 2 * ops.num_steps * batch * 4
+    res, wall = time_run(run, args.reps)
+    got = SA.to_string(BL.blocked_to_flat(ops, res))
+    ok = got == want
+    if not ok and not args.lax_check:
+        raise AssertionError("northstar replay diverged from string oracle")
+    return make_row("northstar_automerge_paper_full", args.engine, n_ops,
+                    batch, wall, ops.num_steps, hbm, base_ops, ok,
+                    reps=args.reps)
+
+
+def cfg_1_cpu(args):
+    """Config 1: single-doc full-trace replay on the CPU reference path."""
+    data = load_testing_data(trace_path("automerge-paper"))
+    patches = flatten_patches(data)
+    t0 = time.perf_counter()
+    base_ops, got = native_replay(patches)
+    wall = len(patches) / base_ops
+    del t0
+    return make_row("config1_automerge_paper_cpu", "native-cpp",
+                    len(patches), 1, wall, len(patches), 0, base_ops,
+                    got == data.end_content)
+
+
+def cfg_2(args):
+    """Config 2: random_edits stream, identical docs in the lane dim."""
+    from text_crdt_rust_tpu.ops import blocked as BL
+    from text_crdt_rust_tpu.ops import blocked_hbm as BH
+
+    steps = 2000 if args.smoke else 20000
+    batch = 64 if args.smoke else 1024
+    patches, content = random_patches(random.Random(42), steps)
+    ops, _ = B.compile_local_patches(patches, lmax=8, dmax=8)
+    ins_total = sum(len(p.ins_content) for p in patches)
+    capacity = 2 << int(np.ceil(np.log2(max(ins_total, 256))))
+    block_k = min(512, capacity // 2)
+    base_ops, base_str = native_replay(patches)
+    assert base_str == content
+
+    run = BH.make_replayer_hbm(ops, capacity=capacity, batch=batch,
+                               block_k=block_k,
+                               chunk=128 if args.smoke else 1024,
+                               interpret=args.interpret)
+    hbm = (2 * capacity + block_k) * batch * 4
+    res, wall = time_run(run, args.reps)
+    got = SA.to_string(BL.blocked_to_flat(ops, res))
+    return make_row("config2_random_edits_identical_docs", "hbm",
+                    len(patches), batch, wall, ops.num_steps, hbm,
+                    base_ops, got == content)
+
+
+def cfg_3(args):
+    """Config 3: ragged mixed corpus (rustcode + sveltecomponent) as
+    divergent doc groups on the HBM engine's grid dimension."""
+    from text_crdt_rust_tpu.ops import blocked as BL
+    from text_crdt_rust_tpu.ops import blocked_hbm as BH
+
+    names = ("rustcode", "sveltecomponent")
+    datas = [load_testing_data(trace_path(n)) for n in names]
+    all_patches = [flatten_patches(d) for d in datas]
+    if args.smoke:
+        all_patches = [p[:400] for p in all_patches]
+    opses, wants = [], []
+    for p in all_patches:
+        ops, _ = B.compile_local_patches(p, lmax=16, dmax=16)
+        opses.append(ops)
+        wants.append(expected_content(p))
+    ins_max = max(sum(len(p.ins_content) for p in ps) for ps in all_patches)
+    capacity = 2 << int(np.ceil(np.log2(max(ins_max, 256))))
+    block_k = min(512, capacity // 2)
+
+    base_total = 0.0
+    for ps, want in zip(all_patches, wants):
+        ops_s, got = native_replay(ps)
+        assert got == want
+        base_total += ops_s
+    base_avg = base_total / len(all_patches)
+
+    run = BH.make_replayer_hbm(opses, capacity=capacity,
+                               batch=args.batch,
+                               block_k=block_k,
+                               chunk=128 if args.smoke else 1024,
+                               interpret=args.interpret)
+    hbm = (len(opses) + 1) * capacity * args.batch * 4
+    results, wall = time_run(run, args.reps)
+    ok = True
+    for ops, res, want in zip(opses, results, wants):
+        got = SA.to_string(BL.blocked_to_flat(ops, res))
+        ok = ok and (got == want)
+    n_ops = sum(len(p) for p in all_patches)
+    steps = max(o.num_steps for o in opses) * len(opses)
+    return make_row("config3_ragged_mixed_corpus", "hbm-groups", n_ops,
+                    args.batch, wall, steps, hbm, base_avg, ok,
+                    groups=list(names))
+
+
+def cfg_4(args):
+    """Config 4: N-peer concurrent-insert storm (tiebreak-heavy remote
+    ops) on the mixed blocked engine."""
+    from text_crdt_rust_tpu.ops import blocked as BL
+    from text_crdt_rust_tpu.ops import blocked_mixed as BM
+
+    n_peers, rounds, run_len = (4, 10, 2) if args.smoke else (16, 200, 4)
+    txns, receiver = make_storm(n_peers, rounds, run_len, seed=7)
+    want = receiver.to_string()
+    base_ops, base_str = native_remote_replay(txns)
+    assert base_str == want
+
+    table = B.AgentTable(sorted({t.id.agent for t in txns}))
+    ops, _ = B.compile_remote_txns(txns, table, lmax=min(16, run_len * 2),
+                                   dmax=16)
+    total_chars = n_peers * rounds * run_len
+    capacity = 2 << int(np.ceil(np.log2(max(total_chars, 256))))
+    block_k = min(256, capacity // 2)
+    run = BM.make_replayer_mixed(ops, capacity=capacity, batch=args.batch,
+                                 block_k=block_k,
+                                 chunk=128 if args.smoke else 1024,
+                                 interpret=args.interpret)
+    hbm = 2 * capacity * args.batch * 4
+    res, wall = time_run(run, args.reps)
+    got = SA.to_string(BL.blocked_to_flat(ops, res))
+    return make_row("config4_concurrent_insert_storm", "blocked-mixed",
+                    total_chars, args.batch, wall, ops.num_steps, hbm,
+                    base_ops, got == want,
+                    peers=n_peers, rounds=rounds)
+
+
+def cfg_5(args):
+    """Config 5: streaming apply over per-doc DIVERGENT streams,
+    delete-heavy, with periodic host<->device checkpoint resync."""
+    from text_crdt_rust_tpu.utils.checkpoint import (
+        load_flat_doc,
+        save_flat_doc,
+    )
+
+    n_docs = 16 if args.smoke else 2048
+    chunks = 3 if args.smoke else 5
+    steps_per_chunk = 30 if args.smoke else 100
+    lmax = 8
+    rngs = [random.Random(1000 + d) for d in range(n_docs)]
+    contents = [""] * n_docs
+
+    def next_chunk():
+        streams = []
+        for d in range(n_docs):
+            # Delete-heavy: ins_prob 0.45 once the doc has content.
+            patches, content = _continue_patches(
+                rngs[d], contents[d], steps_per_chunk, ins_prob=0.45)
+            contents[d] = content
+            streams.append(patches)
+        return streams
+
+    all_chunks = [next_chunk() for _ in range(chunks)]
+    cap = 2048 if args.smoke else 8192
+    total_ins = max(
+        sum(len(p.ins_content) for ch in all_chunks for p in ch[d])
+        for d in range(n_docs))
+    assert total_ins < cap // 2, (total_ins, cap)
+
+    # Baseline: one doc's whole stream on the native engine.
+    flat0 = [p for ch in all_chunks for p in ch[0]]
+    base_ops, base_str = native_replay(flat0)
+    assert base_str == contents[0]
+
+    docs = SA.stack_docs(SA.make_flat_doc(cap, 2 * cap), n_docs)
+    wall = 0.0
+    n_ops = 0
+    steps = 0
+    next_orders = [0] * n_docs
+    ckpt = os.path.join(tempfile.mkdtemp(prefix="tcr_bench_"), "resync.npz")
+    for ci, streams in enumerate(all_chunks):
+        opses = []
+        for d, patches in enumerate(streams):
+            ops, next_orders[d] = B.compile_local_patches(
+                patches, lmax=lmax, start_order=next_orders[d])
+            opses.append(ops)
+            n_ops += len(patches)
+        batched = B.stack_ops(opses)
+        steps += batched.num_steps
+        t0 = time.perf_counter()
+        docs = F.apply_ops_batch(docs, batched)
+        jax.block_until_ready(docs.signed)
+        wall += time.perf_counter() - t0
+        # Periodic resync: checkpoint to host, restore, re-upload.
+        t0 = time.perf_counter()
+        save_flat_doc(docs, ckpt)
+        docs = load_flat_doc(ckpt)
+        wall += time.perf_counter() - t0
+    ok = all(
+        SA.to_string(jax.tree.map(lambda x: x[d], docs)) == contents[d]
+        for d in range(0, n_docs, max(1, n_docs // 8)))
+    hbm = sum(np.asarray(x).nbytes for x in jax.tree.leaves(docs))
+    return make_row("config5_streaming_divergent_resync", "flat-vmap",
+                    n_ops, 1, wall, steps, hbm, base_ops, ok,
+                    docs=n_docs, chunks=chunks)
+
+
+def _continue_patches(rng, content, steps, ins_prob):
+    """random_patches continued from existing content."""
+    patches = []
+    for _ in range(steps):
+        if not content or rng.random() < ins_prob:
+            pos = rng.randint(0, len(content))
+            ins = "".join(rng.choice("abcdefgh ")
+                          for _ in range(rng.randint(1, 4)))
+            patches.append(TestPatch(pos, 0, ins))
+            content = content[:pos] + ins + content[pos:]
+        else:
+            pos = rng.randint(0, len(content) - 1)
+            span = min(rng.randint(1, 4), len(content) - pos)
+            patches.append(TestPatch(pos, span, ""))
+            content = content[:pos] + content[pos + span:]
+    return patches, content
+
+
+def cfg_kevin(args):
+    """kevin (`benches/yjs.rs:51-62`): 5M single-char prepends. Native
+    engine runs the full 5M; the TPU row runs an honestly-labeled prefix
+    (the global rebalance degrades on the pure-prepend worst case)."""
+    from text_crdt_rust_tpu.ops import blocked as BL
+    from text_crdt_rust_tpu.ops import blocked_hbm as BH
+
+    n_native = 50_000 if args.smoke else 5_000_000
+    from text_crdt_rust_tpu.models.native import NativeListCRDT
+    best = float("inf")
+    for _ in range(1 if args.smoke else 2):
+        doc = NativeListCRDT()
+        a = doc.get_or_create_agent_id("kevin")
+        pos = np.zeros(n_native, np.uint32)
+        dels = np.zeros(n_native, np.uint32)
+        il = np.ones(n_native, np.uint32)
+        cps = np.full(n_native, ord(" "), np.uint32)
+        t0 = time.perf_counter()
+        doc.replay_trace(a, pos, dels, il, cps)
+        best = min(best, time.perf_counter() - t0)
+    cpu_row = make_row(f"kevin_cpu_{n_native}", "native-cpp", n_native, 1,
+                       best, n_native, 0, n_native / best,
+                       len(doc) == n_native)
+
+    n_tpu = 2048 if args.smoke else 65_536
+    patches = [TestPatch(0, 0, " ")] * n_tpu
+    ops, _ = B.compile_local_patches(patches, lmax=4, dmax=4)
+    capacity = 2 * n_tpu
+    run = BH.make_replayer_hbm(ops, capacity=capacity, batch=args.batch,
+                               block_k=min(512, capacity // 2),
+                               chunk=128 if args.smoke else 1024,
+                               interpret=args.interpret)
+    res, wall = time_run(run, 1)
+    got_len = int(np.asarray(
+        BL.blocked_to_flat(ops, res).n))
+    tpu_row = make_row(f"kevin_tpu_{n_tpu}", "hbm", n_tpu, args.batch,
+                       wall, ops.num_steps,
+                       2 * capacity * args.batch * 4,
+                       n_native / best, got_len == n_tpu)
+    return [cpu_row, tpu_row]
+
+
+# ------------------------------------------------------------------ main --
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="northstar",
+                    choices=("northstar", "1", "2", "3", "4", "5",
+                             "kevin", "all"))
+    ap.add_argument("--trace", default="automerge-paper")
+    ap.add_argument("--patches", type=int, default=0,
+                    help="northstar trace prefix (0 = FULL trace)")
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--lmax", type=int, default=16)
+    ap.add_argument("--engine", choices=("blocked", "hbm"), default="hbm")
+    ap.add_argument("--block-k", type=int, default=512)
+    ap.add_argument("--chunk", type=int, default=1024)
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--cpu", action="store_true",
+                    help="force CPU backend (logic check; implies "
+                         "--interpret --smoke)")
+    ap.add_argument("--interpret", action="store_true")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny workload sizes (CI / CPU logic checks)")
+    ap.add_argument("--lax-check", action="store_true")
+    ap.add_argument("--out", default="BENCH_ALL.json")
+    args = ap.parse_args()
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+        args.interpret = True
+        args.smoke = True
+        args.reps = 1
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform} {dev.device_kind}")
+
+    fns = {
+        "northstar": cfg_northstar,
+        "1": cfg_1_cpu,
+        "2": cfg_2,
+        "3": cfg_3,
+        "4": cfg_4,
+        "5": cfg_5,
+        "kevin": cfg_kevin,
+    }
+    if args.config != "all":
+        out = fns[args.config](args)
+        rows = out if isinstance(out, list) else [out]
+        print(json.dumps(rows[0] if len(rows) == 1 else rows[0]))
+        if len(rows) > 1:
+            log(json.dumps(rows[1:]))
+        return
+
+    rows = []
+    star = None
+    for key in ("northstar", "1", "2", "3", "4", "5", "kevin"):
+        log(f"=== config {key} ===")
+        try:
+            out = fns[key](args)
+        except Exception as e:  # keep the suite going; record the failure
+            log(f"config {key} FAILED: {type(e).__name__}: {e}")
+            rows.append({"config": key, "error": f"{type(e).__name__}: {e}"})
+            continue
+        if isinstance(out, list):
+            rows.extend(out)
+        else:
+            rows.append(out)
+        if key == "northstar":
+            star = out
+    with open(args.out, "w") as f:
+        json.dump(rows, f, indent=1)
+    log(f"wrote {len(rows)} rows to {args.out}")
+    print(json.dumps(star if star else rows[0]))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,84 @@
+"""Seeded synthetic edit generators for benches, soak, and tests.
+
+``random_patches`` is the `make_random_change` analog
+(`/root/reference/src/list/doc.rs:544-569`, used by the 1M-edit soak
+`examples/simple.rs:14-49` and the commented-out `benches/random_edits.rs`):
+each step either inserts 1..max_ins chars at a random position or deletes
+1..max_del chars, tracked against a plain-string oracle.
+
+``make_storm`` builds the config-4 concurrent-insert storm: N peers each
+type at position 0 of their OWN replica (never seeing each other), so
+every insert of a round is concurrent with every other peer's and the
+receiving document resolves them all through the YATA tiebreak
+(`doc.rs:204-217`) — the tiebreak-heavy workload by construction.
+"""
+from __future__ import annotations
+
+import random
+from typing import List, Tuple
+
+from .testdata import TestPatch
+
+ALPHABET = "abcdefghijklmnopqrstuvwxyz ABCDEFGHIJKLMNOPQRSTUVWXYZ.,\n"
+
+
+def random_patches(
+    rng: random.Random,
+    steps: int,
+    ins_prob: float = 0.6,
+    max_ins: int = 5,
+    max_del: int = 4,
+) -> Tuple[List[TestPatch], str]:
+    """Seeded random edit stream, tracked against a plain string."""
+    content = ""
+    patches = []
+    for _ in range(steps):
+        if not content or rng.random() < ins_prob:
+            pos = rng.randint(0, len(content))
+            ins = "".join(rng.choice(ALPHABET)
+                          for _ in range(rng.randint(1, max_ins)))
+            patches.append(TestPatch(pos, 0, ins))
+            content = content[:pos] + ins + content[pos:]
+        else:
+            pos = rng.randint(0, len(content) - 1)
+            span = min(rng.randint(1, max_del), len(content) - pos)
+            patches.append(TestPatch(pos, span, ""))
+            content = content[:pos] + content[pos + span:]
+    return patches, content
+
+
+def make_storm(n_peers: int, rounds: int, run_len: int, seed: int = 0):
+    """(txns, oracle) for the concurrent-insert storm (config 4).
+
+    Each peer types ``run_len`` chars at position 0 of its own replica
+    every round; the exported txns are interleaved round-robin (a valid
+    causal order — peers only depend on themselves) and applied to a
+    receiving oracle for ground truth.
+    """
+    from ..models.oracle import ListCRDT
+    from ..models.sync import export_txns_since
+
+    rng = random.Random(seed)
+    peers = []
+    for p in range(n_peers):
+        doc = ListCRDT()
+        agent = doc.get_or_create_agent_id(f"peer-{p:03d}")
+        peers.append((doc, agent))
+
+    per_round: List[List] = []
+    marks = [0] * n_peers
+    for _ in range(rounds):
+        round_txns = []
+        for p, (doc, agent) in enumerate(peers):
+            text = "".join(rng.choice(ALPHABET) for _ in range(run_len))
+            doc.local_insert(agent, 0, text)
+            txns = export_txns_since(doc, marks[p])
+            marks[p] = doc.get_next_order()
+            round_txns.extend(txns)
+        per_round.append(round_txns)
+
+    txns = [t for rnd in per_round for t in rnd]
+    receiver = ListCRDT()
+    for t in txns:
+        receiver.apply_remote_txn(t)
+    return txns, receiver
