@@ -1,0 +1,41 @@
+"""Round-3 step-1 measurement: batch sweep x (merged vs unmerged) op stream
+on the round-2 HBM engine, real TPU. Writes perf/sweep_r3.json."""
+import sys, os; sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import json, sys, time
+import numpy as np
+import jax
+from text_crdt_rust_tpu.ops import batch as B
+from text_crdt_rust_tpu.ops import blocked_hbm as BH
+from text_crdt_rust_tpu.utils.testdata import load_testing_data, trace_path, flatten_patches
+
+data = load_testing_data(trace_path("automerge-paper"))
+patches = flatten_patches(data)
+n_ops = len(patches)
+rows = []
+for label, plist, lmax in (("unmerged", patches, 16),
+                           ("merged", B.merge_patches(patches), 128)):
+    ops, _ = B.compile_local_patches(plist, lmax=lmax, dmax=None)
+    print(f"{label}: {ops.num_steps} steps", file=sys.stderr, flush=True)
+    for batch in (128, 256, 512, 1024):
+        try:
+            run = BH.make_replayer_hbm(ops, capacity=524288, batch=batch,
+                                       block_k=512, chunk=1024)
+            t0 = time.perf_counter(); res = run(); res.check()
+            compile_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            for _ in range(3):
+                res = run()
+            res.check()
+            wall = (time.perf_counter() - t0) / 3
+            v = n_ops * batch / wall
+            rows.append(dict(stream=label, batch=batch, steps=ops.num_steps,
+                             wall_s=round(wall, 4),
+                             step_us=round(wall / ops.num_steps * 1e6, 3),
+                             ops_per_sec=round(v, 1),
+                             vs_base=round(v / 2.09e6, 2)))
+            print(json.dumps(rows[-1]), file=sys.stderr, flush=True)
+        except Exception as e:
+            rows.append(dict(stream=label, batch=batch, error=str(e)[:200]))
+            print(json.dumps(rows[-1]), file=sys.stderr, flush=True)
+with open("perf/sweep_r3.json", "w") as f:
+    json.dump(rows, f, indent=1)

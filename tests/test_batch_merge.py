@@ -1,0 +1,122 @@
+"""merge_patches semantics: the RLE-coalesced op stream must be
+indistinguishable from the per-keystroke stream — same final content, same
+spans (orders + tombstones), same order accounting. The merge is the
+op-stream analog of the reference's in-tree merge fast paths
+(`mutations.rs:57-109`); nothing about the CRDT result may change."""
+import random
+
+import numpy as np
+import pytest
+
+from text_crdt_rust_tpu.common import LocalOp
+from text_crdt_rust_tpu.models.oracle import ListCRDT
+from text_crdt_rust_tpu.ops import batch as B
+from text_crdt_rust_tpu.utils.testdata import (
+    TestPatch,
+    flatten_patches,
+    load_testing_data,
+    trace_path,
+)
+
+
+def replay_oracle(patches):
+    doc = ListCRDT(capacity=256)
+    agent = doc.get_or_create_agent_id("A")
+    for p in patches:
+        doc.apply_local_txn(agent, [LocalOp(p.pos, p.ins_content, p.del_len)])
+    return doc
+
+
+def assert_equivalent(patches):
+    merged = B.merge_patches(patches)
+    a = replay_oracle(patches)
+    b = replay_oracle(merged)
+    assert a.to_string() == b.to_string()
+    assert a.doc_spans() == b.doc_spans()
+    assert a.get_next_order() == b.get_next_order()
+    return merged
+
+
+def typing_run(pos, text):
+    return [TestPatch(pos + i, 0, c) for i, c in enumerate(text)]
+
+
+def backspace_run(pos, n):
+    return [TestPatch(pos - i, 1, "") for i in range(1, n + 1)]
+
+
+def test_typing_run_collapses():
+    patches = typing_run(0, "hello world")
+    merged = assert_equivalent(patches)
+    assert len(merged) == 1
+    assert merged[0] == TestPatch(0, 0, "hello world")
+
+
+def test_backspace_run_collapses():
+    patches = typing_run(0, "abcdef") + backspace_run(6, 3)
+    merged = assert_equivalent(patches)
+    assert merged == [TestPatch(0, 0, "abcdef"), TestPatch(3, 3, "")]
+
+
+def test_forward_delete_run_collapses():
+    patches = typing_run(0, "abcdef") + [TestPatch(1, 1, "")] * 3
+    merged = assert_equivalent(patches)
+    assert merged == [TestPatch(0, 0, "abcdef"), TestPatch(1, 3, "")]
+
+
+def test_mixed_patch_breaks_runs():
+    patches = typing_run(0, "abc") + [TestPatch(1, 1, "XY")] + \
+        typing_run(2, "zz")
+    merged = assert_equivalent(patches)
+    # The replace patch can't merge with either neighbor run.
+    assert len(merged) == 3
+
+
+def test_discontiguous_inserts_stay_separate():
+    patches = [TestPatch(0, 0, "aa"), TestPatch(0, 0, "bb")]
+    merged = assert_equivalent(patches)
+    assert len(merged) == 2
+
+
+def test_random_stream_equivalence():
+    rng = random.Random(7)
+    content_len = 0
+    patches = []
+    for _ in range(800):
+        r = rng.random()
+        if content_len == 0 or r < 0.5:
+            pos = rng.randint(0, content_len)
+            ins = rng.choice("abcdefgh")
+            patches.append(TestPatch(pos, 0, ins))
+            content_len += 1
+        else:
+            pos = rng.randint(0, content_len - 1)
+            patches.append(TestPatch(pos, 1, ""))
+            content_len -= 1
+    merged = assert_equivalent(patches)
+    assert len(merged) < len(patches)
+
+
+def test_trace_prefix_equivalence():
+    data = load_testing_data(trace_path("automerge-paper"))
+    patches = flatten_patches(data)[:4000]
+    merged = assert_equivalent(patches)
+    assert len(merged) * 4 < len(patches)  # real traces compress well
+
+
+def test_order_accounting_preserved():
+    data = load_testing_data(trace_path("automerge-paper"))
+    patches = flatten_patches(data)[:4000]
+    merged = B.merge_patches(patches)
+    ops_a, next_a = B.compile_local_patches(patches, lmax=16)
+    ops_b, next_b = B.compile_local_patches(merged, lmax=128)
+    assert next_a == next_b
+    assert (int(np.asarray(ops_a.order_advance, np.int64).sum())
+            == int(np.asarray(ops_b.order_advance, np.int64).sum()))
+
+
+def test_merge_does_not_mutate_input():
+    patches = typing_run(0, "abc")
+    snapshot = [TestPatch(p.pos, p.del_len, p.ins_content) for p in patches]
+    B.merge_patches(patches)
+    assert patches == snapshot

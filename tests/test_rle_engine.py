@@ -1,0 +1,194 @@
+"""RLE run-blocked engine vs the flat engine and string oracle.
+
+Interpreter-mode differential tests in the ``test_blocked_hbm`` mold:
+tiny blocks (block_k as low as 8 RUNS) force constant leaf SPLITS — the
+engine's replacement for the global rebalance — so the logical-block-order
+machinery is exercised on every few ops, the analog of the reference's
+shrunken debug node sizes (`range_tree/mod.rs:29-39`). Streams are
+compiled through ``merge_patches`` (the production path) AND raw, so both
+run-granular and per-keystroke ops hit the kernel.
+"""
+import random
+
+import numpy as np
+import pytest
+
+from text_crdt_rust_tpu.ops import batch as B
+from text_crdt_rust_tpu.ops import flat as F
+from text_crdt_rust_tpu.ops import rle as R
+from text_crdt_rust_tpu.ops import span_arrays as SA
+from text_crdt_rust_tpu.utils.testdata import (
+    TestPatch,
+    flatten_patches,
+    load_testing_data,
+    trace_path,
+)
+
+from test_device_flat import random_patches
+
+
+def run_rle(patches, capacity, block_k, merge=True, chunk=128):
+    plist = B.merge_patches(patches) if merge else patches
+    lmax = max([len(p.ins_content) for p in plist] + [1])
+    ops, _ = B.compile_local_patches(plist, lmax=lmax, dmax=None)
+    res = R.replay_local_rle(ops, capacity=capacity, batch=8,
+                             block_k=block_k, chunk=chunk, interpret=True)
+    return ops, R.rle_to_flat(ops, res)
+
+
+def ref_doc(patches, capacity=1024):
+    """Flat-engine reference on the UNMERGED per-keystroke stream."""
+    ops, _ = B.compile_local_patches(patches, lmax=16, dmax=None)
+    return F.apply_ops(SA.make_flat_doc(capacity), ops)
+
+
+class TestRleReplay:
+    def test_smoke(self):
+        patches = [TestPatch(0, 0, "hello world"), TestPatch(5, 0, ","),
+                   TestPatch(2, 3, "LLO"), TestPatch(0, 1, "H")]
+        _, doc = run_rle(patches, capacity=64, block_k=8)
+        ref = ref_doc(patches, 64)
+        assert SA.to_string(doc) == SA.to_string(ref) == "HeLLO, world"
+        assert SA.doc_spans(doc) == SA.doc_spans(ref)
+
+    @pytest.mark.parametrize("seed", [7, 11, 99])
+    @pytest.mark.parametrize("merge", [True, False])
+    def test_random_vs_flat(self, seed, merge):
+        rng = random.Random(seed)
+        patches, content = random_patches(rng, 80)
+        _, doc = run_rle(patches, capacity=256, block_k=8, merge=merge)
+        ref = ref_doc(patches, 512)
+        assert SA.to_string(doc) == SA.to_string(ref) == content
+        assert SA.doc_spans(doc) == SA.doc_spans(ref)
+
+    def test_mid_run_split_insert(self):
+        # One long run, then an insert strictly inside it: 3-way splice.
+        patches = [TestPatch(0, 0, "abcdefghij"), TestPatch(5, 0, "XY")]
+        _, doc = run_rle(patches, capacity=64, block_k=8)
+        assert SA.to_string(doc) == "abcdeXYfghij"
+
+    def test_delete_three_way_split(self):
+        # Delete strictly inside one run: head + tombstone + tail rows.
+        patches = [TestPatch(0, 0, "abcdefghij"), TestPatch(3, 4, "")]
+        _, doc = run_rle(patches, capacity=64, block_k=8)
+        ref = ref_doc(patches, 64)
+        assert SA.to_string(doc) == SA.to_string(ref) == "abchij"
+        assert SA.doc_spans(doc) == SA.doc_spans(ref)
+
+    def test_delete_spanning_blocks(self):
+        # Many tiny runs (discontiguous inserts), then one delete across
+        # several blocks: boundary splits in two different blocks.
+        patches = []
+        for _ in range(24):
+            patches.append(TestPatch(0, 0, "ab"))
+        patches.append(TestPatch(2, 40, ""))
+        _, doc = run_rle(patches, capacity=128, block_k=8, merge=False)
+        ref = ref_doc(patches, 128)
+        assert SA.to_string(doc) == SA.to_string(ref)
+        assert SA.doc_spans(doc) == SA.doc_spans(ref)
+
+    def test_insert_before_tombstones(self):
+        # Insert at a position whose successor is a tombstone: the raw
+        # successor (doc.rs:452 — not skipped) feeds origin_right.
+        patches = [TestPatch(0, 0, "abcdef"), TestPatch(2, 2, ""),
+                   TestPatch(2, 0, "XY")]
+        _, doc = run_rle(patches, capacity=64, block_k=8)
+        ref = ref_doc(patches, 64)
+        assert SA.to_string(doc) == SA.to_string(ref) == "abXYef"
+        assert SA.doc_spans(doc) == SA.doc_spans(ref)
+
+    def test_insert_at_zero_before_leading_tombstone(self):
+        patches = [TestPatch(0, 0, "abc"), TestPatch(0, 2, ""),
+                   TestPatch(0, 0, "Z")]
+        _, doc = run_rle(patches, capacity=64, block_k=8)
+        ref = ref_doc(patches, 64)
+        assert SA.to_string(doc) == SA.to_string(ref) == "Zc"
+        assert SA.doc_spans(doc) == SA.doc_spans(ref)
+
+    def test_prepend_heavy_splits(self):
+        # kevin shape: every insert at pos 0 — runs can't merge, slot 0
+        # splits over and over; logical order must stay consistent.
+        patches = [TestPatch(0, 0, "ab") for _ in range(40)]
+        _, doc = run_rle(patches, capacity=256, block_k=8, merge=False)
+        ref = ref_doc(patches, 256)
+        assert SA.to_string(doc) == SA.to_string(ref) == "ab" * 40
+        assert SA.doc_spans(doc) == SA.doc_spans(ref)
+
+    def test_append_merge_compresses(self):
+        # Order-contiguous typing compiled UNMERGED must still compress
+        # into one device run via the in-kernel append fast path.
+        patches = [TestPatch(i, 0, "x") for i in range(50)]
+        ops, _ = B.compile_local_patches(patches, lmax=1, dmax=None)
+        res = R.replay_local_rle(ops, capacity=64, batch=8, block_k=8,
+                                 chunk=128, interpret=True)
+        rows_used = int(np.asarray(res.rows).sum(axis=0)[0])
+        assert rows_used == 1  # 50 keystrokes -> one run row
+        assert SA.to_string(R.rle_to_flat(ops, res)) == "x" * 50
+
+    def test_far_jump_edits(self):
+        patches = [TestPatch(0, 0, "abcdefgh")]
+        for k in range(12):
+            patches.append(TestPatch(0, 0, "xy"))
+            patches.append(TestPatch(8 + 2 * k, 0, "pq"))
+        _, doc = run_rle(patches, capacity=128, block_k=8, merge=False)
+        ref = ref_doc(patches, 128)
+        assert SA.to_string(doc) == SA.to_string(ref)
+        assert SA.doc_spans(doc) == SA.doc_spans(ref)
+
+    @pytest.mark.slow
+    def test_trace_prefix(self):
+        data = load_testing_data(trace_path("automerge-paper"))
+        patches = flatten_patches(data)[:400]
+        _, doc = run_rle(patches, capacity=256, block_k=16)
+        ref = ref_doc(patches, 1024)
+        assert SA.to_string(doc) == SA.to_string(ref)
+        assert SA.doc_spans(doc) == SA.doc_spans(ref)
+
+    def test_block_exhaustion_flagged(self):
+        # Discontiguous runs overflow a tiny capacity: the kernel must
+        # raise the block-capacity flag, not corrupt state.
+        patches = [TestPatch(0, 0, "ab") for _ in range(40)]
+        ops, _ = B.compile_local_patches(patches, lmax=2, dmax=None)
+        res = R.replay_local_rle(ops, capacity=16, batch=8, block_k=8,
+                                 chunk=128, interpret=True)
+        with pytest.raises(RuntimeError, match="out of blocks"):
+            res.check()
+
+    def test_bad_delete_flagged(self):
+        patches = [TestPatch(0, 0, "abc"), TestPatch(0, 10, "")]
+        ops, _ = B.compile_local_patches(patches, lmax=4, dmax=None)
+        res = R.replay_local_rle(ops, capacity=32, batch=8, block_k=8,
+                                 chunk=128, interpret=True)
+        with pytest.raises(RuntimeError, match="past the end"):
+            res.check()
+
+
+class TestRleGroups:
+    def test_divergent_streams(self):
+        rng = random.Random(404)
+        opses, contents = [], []
+        for gi in range(3):
+            patches, content = random_patches(rng, 40 + 10 * gi)
+            merged = B.merge_patches(patches)
+            lmax = max(len(p.ins_content) for p in merged if p.ins_content)
+            ops, _ = B.compile_local_patches(merged, lmax=lmax, dmax=None)
+            opses.append(ops)
+            contents.append(content)
+        run = R.make_replayer_rle(opses, capacity=256, batch=8,
+                                  block_k=8, chunk=128, interpret=True)
+        results = run()
+        assert len(results) == 3
+        for ops, res, content in zip(opses, results, contents):
+            assert SA.to_string(R.rle_to_flat(ops, res)) == content
+
+
+class TestExpandRuns:
+    def test_signs_and_orders(self):
+        patches = [TestPatch(0, 0, "abcd"), TestPatch(1, 2, "")]
+        ops, _ = B.compile_local_patches(
+            B.merge_patches(patches), lmax=4, dmax=None)
+        res = R.replay_local_rle(ops, capacity=32, batch=8, block_k=8,
+                                 chunk=128, interpret=True)
+        flat = R.expand_runs(res)
+        # orders 0..3 in doc order; chars b,c (orders 1,2) tombstoned.
+        assert list(flat) == [1, -2, -3, 4]

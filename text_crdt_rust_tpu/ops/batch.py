@@ -223,6 +223,55 @@ class _Rows:
         )
 
 
+def merge_patches(patches: Sequence[TestPatch]) -> List[TestPatch]:
+    """RLE-coalesce adjacent same-kind, position-contiguous patches.
+
+    The op-stream analog of the reference's in-tree merge fast paths
+    (`mutations.rs:57-109`): a typing run (each insert continuing at
+    ``pos + len(prev)``), a forward-delete run (same ``pos``), or a
+    backspace run (next delete ending at the previous ``pos``) collapses
+    to ONE op. The merged stream is *semantically identical* to the
+    per-keystroke stream — same final state, same per-char orders, same
+    origins:
+
+    - insert runs: char k of the merged run gets origin_left = char k-1
+      and the shared origin_right, exactly the implicit origin chain a
+      span keeps (`span.rs:9-18,24-28`); the unmerged stream's per-patch
+      head origins resolve to the same values because nothing intervenes
+      between the coalesced patches;
+    - delete runs: the same char set is tombstoned and the same number
+      of orders is consumed (order totals are preserved patch-for-patch),
+      so device state and ``next_order`` are bit-identical;
+    - mixed (delete+insert) patches and any position discontinuity break
+      the run, so no reordering across unrelated edits ever happens.
+
+    automerge-paper: 259,778 patches -> 10,712 merged ops (24.3x fewer
+    device steps). Callers report ops/s against the ORIGINAL patch
+    count; the merged stream is an execution strategy, not a workload
+    reduction (the native baseline replays the unmerged stream).
+    """
+    out: List[TestPatch] = []
+    for p in patches:
+        if out:
+            q = out[-1]
+            if (q.del_len == 0 and p.del_len == 0 and p.ins_content
+                    and q.ins_content
+                    and p.pos == q.pos + len(q.ins_content)):
+                q.ins_content += p.ins_content
+                continue
+            if (not q.ins_content and not p.ins_content
+                    and q.del_len and p.del_len):
+                if p.pos == q.pos:               # forward-delete run
+                    q.del_len += p.del_len
+                    continue
+                if p.pos + p.del_len == q.pos:   # backspace run
+                    q.pos = p.pos
+                    q.del_len += p.del_len
+                    continue
+        out.append(TestPatch(p.pos, p.del_len, p.ins_content))
+    return out
+
+
 def compile_local_patches(
     patches: Sequence[TestPatch],
     rank: int = 0,

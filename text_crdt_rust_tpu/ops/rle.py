@@ -1,0 +1,571 @@
+"""Run-length blocked replay engine: RLE spans on device, VMEM-resident.
+
+The round-2 engines stored ONE ROW PER CHARACTER (524,288 rows for the
+automerge-paper trace) and applied one per-keystroke op per sequential
+step.  This engine is the blueprint's missing core (SURVEY §7 "flat RLE
+span arrays"): device state is the run — the same compression the
+reference's `YjsSpan` B-tree entries carry (`src/list/span.rs:6-119`,
+16 B/span) — and the op stream is RLE-merged (`ops.batch.merge_patches`),
+so the whole automerge-paper trace is 10,712 device steps over ~13k rows
+instead of 259,778 steps over 524k rows:
+
+- state is two VMEM planes, ``ordp`` = ±(start_order+1) (sign = live /
+  tombstone, 0 = empty slot) and ``lenp`` = run char length; a run row
+  encodes `span.rs:9-13`'s implicit order chain — char k of a run has
+  order ``start+k`` — so splits are index arithmetic (`span.rs:33-45`);
+- rows pack into blocks of ``K`` runs; per-block LIVE-CHAR counts play
+  the B-tree's subtree sums (`range_tree/mod.rs:85-93`): position→block
+  is a masked scan over ≤``NB`` block sums, position→run one in-block
+  cumsum — O(NB + K) per op on runs, not characters;
+- an insert touches ≤3 rows (split + new run + tail) NO MATTER HOW LONG
+  the inserted text is — the per-op cost is independent of ``ins_len``,
+  which is what makes the merged stream pay off;
+- a delete flips sign on covered runs and splits at most the two
+  boundary runs (`mutations.rs:520-570` semantics, tombstones =
+  sign-flip per `span.rs:110-119`);
+- blocks never rebalance globally: a full block SPLITS — the top half
+  moves to a fresh physical block spliced into a LOGICAL block-order
+  table — the device analog of the reference's leaf split
+  (`mutations.rs:623-669`), O(K) per split and amortized O(1) per op.
+  This removes the O(capacity)-per-overflow pathology that kept the
+  round-2 engines off the pure-prepend worst case (`benches/yjs.rs:51-62`);
+- documents batch in the lane dimension (identical-stream lanes), and
+  divergent doc GROUPS ride a leading grid dimension exactly like
+  ``ops.blocked_hbm`` (config-3 ragged corpus shape).
+
+Origins a local insert discovers (`doc.rs:447-453`) are emitted per op:
+``origin_left`` of the run head, with the rest of the run chained
+implicitly host-side (`span.rs:24-28`); ``origin_right`` is the raw
+successor (tombstones NOT skipped, the `doc.rs:452` behavior the other
+engines match).  ``rle_to_flat`` expands the run rows to the standard
+per-char ``FlatDoc`` so every downstream consumer (sync, checkpoint,
+oracle diff) is unchanged.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..common import ROOT_ORDER
+from .batch import KIND_LOCAL, OpTensors, prefill_logs
+from .blocked import _cumsum_rows, _lane_scalar, _require, _shift_rows
+from .span_arrays import FlatDoc, I32, U32, make_flat_doc
+
+
+def _shift_rows_up(x, amount, max_amount: int) -> jax.Array:
+    """Rows shifted toward LOWER indices by dynamic ``amount`` (the
+    mirror of ``blocked._shift_rows``): out[j] = x[j + amount]."""
+    out = x
+    n = x.shape[0]
+    for b in range(max(max_amount, 1).bit_length()):
+        s = (1 << b) % n
+        if s:
+            out = jnp.where((amount >> b) & 1 != 0,
+                            pltpu.roll(out, n - s, axis=0), out)
+    return out
+
+
+def _rle_kernel(
+    pos_ref, dlen_ref, ilen_ref, start_ref,     # [1,CHUNK] SMEM op columns
+    ol_ref, or_ref,                             # [1,CHUNK,B] VMEM outputs
+    ord_out, len_out,                           # [CAP,B] final state planes
+    blk_out, rows_out, meta_out, err_ref,       # tables + flags
+    ordp, lenp, blkord, rws, liv, meta,         # persistent scratch
+    *, K: int, NB: int, NBL: int, CHUNK: int,
+):
+    B = ordp.shape[1]
+    g = pl.program_id(0)
+    i = pl.program_id(1)
+    last = pl.num_programs(1) - 1
+    idx_k = lax.broadcasted_iota(jnp.int32, (K, B), 0)
+    idx_l = lax.broadcasted_iota(jnp.int32, rws.shape, 0)
+    root_u = jnp.uint32(ROOT_ORDER)
+
+    ol_ref[:] = jnp.zeros_like(ol_ref)
+    or_ref[:] = jnp.zeros_like(or_ref)
+
+    @pl.when((g == 0) & (i == 0))
+    def _init_err():
+        err_ref[:] = jnp.zeros_like(err_ref)
+
+    @pl.when(i == 0)
+    def _init():
+        # Fresh group: empty document, one empty block in logical slot 0.
+        ordp[:] = jnp.zeros_like(ordp)
+        lenp[:] = jnp.zeros_like(lenp)
+        blkord[:] = jnp.zeros_like(blkord)
+        rws[:] = jnp.zeros_like(rws)
+        liv[:] = jnp.zeros_like(liv)
+        meta[0] = 1  # blocks in use (logical slots == physical blocks)
+
+    def slot_scalar(tbl, l):
+        return _lane_scalar(jnp.where(idx_l == l, tbl[:], 0))
+
+    def row_scalar(arr2d, r):
+        return jnp.max(jnp.sum(jnp.where(idx_k == r, arr2d, 0), axis=0))
+
+    def live_before_slot(l):
+        return _lane_scalar(jnp.where(idx_l < l, liv[:], 0))
+
+    def slot_of_live_rank(rank1):
+        """Smallest logical slot whose cumulative live-char count reaches
+        ``rank1`` (the B-tree descent `root.rs:54-88` over block sums)."""
+        nlog = meta[0]
+        cum = _cumsum_rows(jnp.where(idx_l < nlog, liv[:], 0))
+        hit = (cum < rank1) & (idx_l < nlog)
+        return jnp.minimum(
+            jnp.max(jnp.sum(hit.astype(jnp.int32), axis=0)), nlog - 1)
+
+    def split(l):
+        """Leaf split (`mutations.rs:623-669`): move the top half of slot
+        ``l``'s rows to a fresh physical block and splice it into the
+        logical order at ``l+1``. O(K); never a global rebalance."""
+        nlog = meta[0]
+
+        @pl.when(nlog >= NB)
+        def _cap():
+            err_ref[0:1, :] = jnp.ones((1, B), jnp.int32)
+
+        b = slot_scalar(blkord, l)
+        r = slot_scalar(rws, l)
+        keep = r // 2
+        mv = r - keep
+        nb = jnp.minimum(nlog, NB - 1)  # fresh physical block id
+        bo = ordp[pl.ds(b * K, K), :]
+        bl = lenp[pl.ds(b * K, K), :]
+        liv_hi = _lane_scalar(jnp.where(
+            (idx_k >= keep) & (idx_k < r) & (bo > 0), bl, 0))
+        liv_lo = slot_scalar(liv, l) - liv_hi
+
+        up_o = _shift_rows_up(bo, keep, K)
+        up_l = _shift_rows_up(bl, keep, K)
+        new_mask = idx_k < mv
+        ordp[pl.ds(nb * K, K), :] = jnp.where(new_mask, up_o, 0)
+        lenp[pl.ds(nb * K, K), :] = jnp.where(new_mask, up_l, 0)
+        keep_mask = idx_k < keep
+        ordp[pl.ds(b * K, K), :] = jnp.where(keep_mask, bo, 0)
+        lenp[pl.ds(b * K, K), :] = jnp.where(keep_mask, bl, 0)
+
+        # Splice the new block into the logical order at slot l+1.
+        for tbl in (blkord, rws, liv):
+            shifted = _shift_rows(tbl[:], 1, 1)
+            tbl[:] = jnp.where(idx_l <= l, tbl[:], shifted)
+        rws[pl.ds(l, 1), :] = jnp.broadcast_to(keep, (1, B))
+        liv[pl.ds(l, 1), :] = jnp.broadcast_to(liv_lo, (1, B))
+        blkord[pl.ds(l + 1, 1), :] = jnp.broadcast_to(nb, (1, B))
+        rws[pl.ds(l + 1, 1), :] = jnp.broadcast_to(mv, (1, B))
+        liv[pl.ds(l + 1, 1), :] = jnp.broadcast_to(liv_hi, (1, B))
+        meta[0] = nlog + 1
+
+    def find_insert_slot(p):
+        l = jnp.where(p == 0, 0, slot_of_live_rank(p))
+        return l, slot_scalar(rws, l)
+
+    def do_insert(k, p, il, st):
+        """Insert an ``il``-char run after live rank ``p``
+        (`mutations.rs:17-179`): ≤3 touched rows regardless of ``il``."""
+        l, r0 = find_insert_slot(p)
+
+        @pl.when(r0 + 2 > K)
+        def _():
+            split(l)
+
+        l, r0 = find_insert_slot(p)
+        b = slot_scalar(blkord, l)
+        base = live_before_slot(l)
+        local = p - base
+        bo = ordp[pl.ds(b * K, K), :]
+        bl = lenp[pl.ds(b * K, K), :]
+        lv = jnp.where(bo > 0, bl, 0)
+        cum = _cumsum_rows(lv)
+        # Run containing live char #local (1-based); a live run by
+        # construction — tombstone rows don't advance ``cum``.
+        i_r = jnp.max(jnp.sum(
+            ((cum < local) & (idx_k < r0)).astype(jnp.int32), axis=0))
+        o_r = row_scalar(bo, i_r)
+        l_r = row_scalar(bl, i_r)
+        off = local - (row_scalar(cum, i_r) - row_scalar(lv, i_r))
+
+        left = jnp.where(p == 0, root_u,
+                         ((o_r - 1) + (off - 1)).astype(jnp.uint32))
+        # Device-state run merge: order-contiguous live extension of run
+        # i_r compresses in place (state compaction only — YjsSpan merge
+        # predicates live host-side; this run is raw doc order).
+        mrg = (p > 0) & (off == l_r) & ((st + 1) == (o_r + l_r))
+        is_split = (p > 0) & (off < l_r)
+
+        # Raw successor (`doc.rs:452`: tombstones not skipped).
+        nxt_in_blk = row_scalar(bo, i_r + 1)  # 0 when i_r is the last row
+        nlog = meta[0]
+        b2 = slot_scalar(blkord, jnp.minimum(l + 1, NBL - 1))
+        nxt_slot_o = jnp.max(jnp.sum(jnp.where(
+            idx_k == 0, ordp[pl.ds(b2 * K, K), :], 0), axis=0))
+        succ_signed = jnp.where(
+            i_r + 1 < r0, nxt_in_blk,
+            jnp.where(l + 1 < nlog, nxt_slot_o, 0))
+        first_o = row_scalar(bo, 0)  # p == 0 successor: the raw doc head
+        succ_p0 = jnp.where(r0 > 0, first_o, 0)
+        succ = jnp.where(p == 0, succ_p0,
+                         jnp.where(is_split, o_r + off, succ_signed))
+        right = jnp.where(succ == 0, root_u,
+                          (jnp.abs(succ) - 1).astype(jnp.uint32))
+
+        ins_at = jnp.where(p == 0, 0, i_r + 1)
+        amt = jnp.where(mrg, 0, jnp.where(is_split, 2, 1))
+        so = _shift_rows(bo, amt, 2)
+        sl = _shift_rows(bl, amt, 2)
+        no = jnp.where(idx_k < ins_at, bo, so)
+        nl = jnp.where(idx_k < ins_at, bl, sl)
+        nl = jnp.where(is_split & (idx_k == i_r), off, nl)
+        new_run = (idx_k == ins_at) & jnp.logical_not(mrg)
+        no = jnp.where(new_run, st + 1, no)
+        nl = jnp.where(new_run, il, nl)
+        tail = is_split & (idx_k == ins_at + 1)
+        no = jnp.where(tail, o_r + off, no)
+        nl = jnp.where(tail, l_r - off, nl)
+        nl = jnp.where(mrg & (idx_k == i_r), l_r + il, nl)
+        ordp[pl.ds(b * K, K), :] = no
+        lenp[pl.ds(b * K, K), :] = nl
+        rws[pl.ds(l, 1), :] = rws[pl.ds(l, 1), :] + amt
+        liv[pl.ds(l, 1), :] = liv[pl.ds(l, 1), :] + il
+
+        ol_ref[:, pl.ds(k, 1), :] = jnp.broadcast_to(left, (1, 1, B))
+        or_ref[:, pl.ds(k, 1), :] = jnp.broadcast_to(right, (1, 1, B))
+
+    def do_delete(p, d):
+        """Tombstone ``d`` live chars after live rank ``p``: per block,
+        flip fully-covered runs and split at most the two boundary runs
+        (`mutations.rs:520-570`; `doc.rs:311-334` fragmentation)."""
+
+        def apply_partial(active, i_p, cs, ce, bo, bl):
+            """Split partial row ``i_p`` into ≤3 parts in-register.
+            Masked no-op when ``active`` is false."""
+            o = row_scalar(bo, i_p)
+            ln = row_scalar(bl, i_p)
+            cs_i = row_scalar(cs, i_p)
+            ce_i = row_scalar(ce, i_p)
+            cov_i = ce_i - cs_i
+            has_head = (cs_i > 0) & active
+            has_tail = (ce_i < ln) & active
+            amt = has_head.astype(jnp.int32) + has_tail.astype(jnp.int32)
+            so = _shift_rows(bo, amt, 2)
+            sl = _shift_rows(bl, amt, 2)
+            no = jnp.where(idx_k <= i_p, bo, so)
+            nl = jnp.where(idx_k <= i_p, bl, sl)
+            # Part layout: [head?] [tombstone mid] [tail?]; the tombstone
+            # start encodes as -(o + cs) per the ±(order+1) convention.
+            p0o = jnp.where(has_head, o, -(o + cs_i))
+            p0l = jnp.where(has_head, cs_i, cov_i)
+            p1o = jnp.where(has_head, -(o + cs_i), o + ce_i)
+            p1l = jnp.where(has_head, cov_i, ln - ce_i)
+            w0 = active & (idx_k == i_p)
+            no = jnp.where(w0, p0o, no)
+            nl = jnp.where(w0, p0l, nl)
+            w1 = active & (idx_k == i_p + 1) & (amt >= 1)
+            no = jnp.where(w1, p1o, no)
+            nl = jnp.where(w1, p1l, nl)
+            w2 = active & (idx_k == i_p + 2) & (amt == 2)
+            no = jnp.where(w2, o + ce_i, no)
+            nl = jnp.where(w2, ln - ce_i, nl)
+            return no, nl, amt
+
+        def body(carry):
+            rem, iters = carry
+            l = slot_of_live_rank(p + 1)
+
+            @pl.when(slot_scalar(rws, l) + 2 > K)
+            def _():
+                split(l)
+
+            l = slot_of_live_rank(p + 1)
+            b = slot_scalar(blkord, l)
+            base = live_before_slot(l)
+            bo = ordp[pl.ds(b * K, K), :]
+            bl = lenp[pl.ds(b * K, K), :]
+            lv = jnp.where(bo > 0, bl, 0)
+            cum = _cumsum_rows(lv)
+            before = base + cum - lv
+            cs = jnp.clip(p - before, 0, lv)
+            ce = jnp.clip(p + rem - before, 0, lv)
+            cov = ce - cs
+            tot = jnp.max(jnp.sum(cov, axis=0))
+            full = (cov > 0) & (cov == bl)
+            part = (cov > 0) & jnp.logical_not(full)
+            npart = jnp.max(jnp.sum(part.astype(jnp.int32), axis=0))
+            i1 = jnp.max(jnp.min(jnp.where(part, idx_k, K), axis=0))
+            i2 = jnp.max(jnp.max(jnp.where(part, idx_k, -1), axis=0))
+
+            bo = jnp.where(full, -bo, bo)
+            # Higher-index boundary first so i1's row index stays valid.
+            bo, bl, a2 = apply_partial(npart >= 1, i2, cs, ce, bo, bl)
+            bo, bl, a1 = apply_partial(npart == 2, i1, cs, ce, bo, bl)
+            ordp[pl.ds(b * K, K), :] = bo
+            lenp[pl.ds(b * K, K), :] = bl
+            rws[pl.ds(l, 1), :] = rws[pl.ds(l, 1), :] + a1 + a2
+            liv[pl.ds(l, 1), :] = liv[pl.ds(l, 1), :] - tot
+            return rem - tot, iters + 1
+
+        # Each iteration clears one block's covered span; > 2*NBL
+        # iterations means the delete ran off the document.
+        rem, _ = lax.while_loop(
+            lambda c: (c[0] > 0) & (c[1] <= 2 * NBL), body, (d, 0))
+
+        @pl.when(rem > 0)
+        def _bad_delete():
+            err_ref[1:2, :] = jnp.ones((1, B), jnp.int32)
+
+    def op_body(k, _):
+        p = pos_ref[0, k]
+        d = dlen_ref[0, k]
+        il = ilen_ref[0, k]
+        st = start_ref[0, k]
+
+        @pl.when(d > 0)
+        def _():
+            do_delete(p, d)
+
+        @pl.when(il > 0)
+        def _():
+            do_insert(k, p, il, st)
+
+        return 0
+
+    lax.fori_loop(0, CHUNK, op_body, 0)
+
+    @pl.when(i == last)
+    def _flush():
+        ord_out[:] = ordp[:]
+        len_out[:] = lenp[:]
+        blk_out[:] = blkord[:][jnp.newaxis]
+        rows_out[:] = rws[:][jnp.newaxis]
+        row0 = lax.broadcasted_iota(jnp.int32, (1, 8, B), 1) == 0
+        meta_out[:] = jnp.where(row0, meta[0], 0)
+
+
+@dataclasses.dataclass
+class RleResult:
+    """Device outputs of one RLE replay (one doc group)."""
+
+    ordp: jax.Array     # i32[CAP, B] ±(start_order+1) per run row
+    lenp: jax.Array     # i32[CAP, B] run char length
+    blkord: jax.Array   # i32[NBLp, B] logical slot -> physical block
+    rows: jax.Array     # i32[NBLp, B] occupied rows per logical slot
+    meta: jax.Array     # i32[8, B]   row 0: blocks in use
+    ol: jax.Array       # u32[S, B]   per-op run-head origin_left
+    orr: jax.Array      # u32[S, B]   per-op origin_right
+    err: jax.Array      # i32[8, B]   0: block capacity; 1: bad delete
+    block_k: int
+    num_blocks: int
+    batch: int
+
+    def check(self) -> None:
+        err = np.asarray(self.err)
+        if err[0].max() != 0:
+            raise RuntimeError(
+                "rle engine out of blocks (every split consumed); raise "
+                "capacity")
+        if err[1].max() != 0:
+            raise RuntimeError(
+                "delete ran past the end of the document (invalid op "
+                "stream)")
+
+
+def make_replayer_rle(
+    ops,
+    capacity: int,
+    batch: int = 128,
+    block_k: int = 256,
+    chunk: int = 1024,
+    interpret: bool = False,
+):
+    """Build a jitted replayer for one local-edit stream (or a SEQUENCE
+    of streams — divergent doc groups on a leading grid dimension, the
+    ``blocked_hbm`` group contract).
+
+    ``capacity`` counts RUN ROWS, not characters: automerge-paper peaks
+    at 13,218 rows (vs 524,288 char rows) — compile the stream with
+    ``merge_patches`` first or every keystroke costs a row.
+    """
+    grouped = isinstance(ops, (list, tuple))
+    streams = list(ops) if grouped else [ops]
+    G = len(streams)
+    _require(G >= 1, "need at least one op stream")
+    for st in streams:
+        kinds = np.asarray(st.kind)
+        _require(kinds.ndim == 1, "rle engine takes per-group shared "
+                 "streams (no per-lane batching inside a group)")
+        _require(bool((kinds == KIND_LOCAL).all()),
+                 "rle engine replays local streams; remote ops -> "
+                 "ops.blocked_mixed / ops.flat")
+    _require(capacity % block_k == 0,
+             f"capacity ({capacity}) must be a multiple of block_k "
+             f"({block_k})")
+    _require(interpret or chunk % 1024 == 0 or (
+        jax.default_backend() != "tpu"),
+        "chunk must be a multiple of 1024 on TPU")
+    NB = capacity // block_k
+    _require(NB >= 1, "need at least one block")
+    _require(block_k >= 8, "block_k must hold a few runs")
+    NBLp = max(8, NB)
+
+    lens = [st.num_steps for st in streams]
+    s_pad = max(((max(lens) + chunk - 1) // chunk) * chunk, chunk)
+
+    def staged_col(get):
+        cols = []
+        for st in streams:
+            a = np.asarray(get(st), dtype=np.int32)
+            cols.append(np.pad(a, ((0, s_pad - len(a)),)))
+        return jnp.asarray(np.stack(cols))          # [G, s_pad]
+
+    staged = (staged_col(lambda o: o.pos),
+              staged_col(lambda o: o.del_len),
+              staged_col(lambda o: o.ins_len),
+              staged_col(lambda o: o.ins_order_start))
+
+    smem = lambda: pl.BlockSpec(
+        (1, chunk), lambda g, i: (g, i), memory_space=pltpu.SMEM)
+
+    call = pl.pallas_call(
+        partial(_rle_kernel, K=block_k, NB=NB, NBL=NBLp, CHUNK=chunk),
+        grid=(G, s_pad // chunk),
+        in_specs=[smem(), smem(), smem(), smem()],
+        out_specs=[
+            pl.BlockSpec((1, chunk, batch), lambda g, i: (g, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, chunk, batch), lambda g, i: (g, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((capacity, batch), lambda g, i: (g, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((capacity, batch), lambda g, i: (g, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, NBLp, batch), lambda g, i: (g, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, NBLp, batch), lambda g, i: (g, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 8, batch), lambda g, i: (g, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((8, batch), lambda g, i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((G, s_pad, batch), jnp.uint32),
+            jax.ShapeDtypeStruct((G, s_pad, batch), jnp.uint32),
+            jax.ShapeDtypeStruct((G * capacity, batch), jnp.int32),
+            jax.ShapeDtypeStruct((G * capacity, batch), jnp.int32),
+            jax.ShapeDtypeStruct((G, NBLp, batch), jnp.int32),
+            jax.ShapeDtypeStruct((G, NBLp, batch), jnp.int32),
+            jax.ShapeDtypeStruct((G, 8, batch), jnp.int32),
+            jax.ShapeDtypeStruct((8, batch), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((capacity, batch), jnp.int32),   # ordp
+            pltpu.VMEM((capacity, batch), jnp.int32),   # lenp
+            pltpu.VMEM((NBLp, batch), jnp.int32),       # blkord
+            pltpu.VMEM((NBLp, batch), jnp.int32),       # rws
+            pltpu.VMEM((NBLp, batch), jnp.int32),       # liv
+            pltpu.SMEM((2,), jnp.int32),                # meta
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=110 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )
+    jitted = jax.jit(lambda a, b, c, d: call(a, b, c, d))
+
+    def run():
+        ol, orr, ordp, lenp, blk, rows, meta, err = jitted(*staged)
+        results = [
+            RleResult(
+                ordp=ordp[gi * capacity:(gi + 1) * capacity],
+                lenp=lenp[gi * capacity:(gi + 1) * capacity],
+                blkord=blk[gi], rows=rows[gi], meta=meta[gi],
+                ol=ol[gi, :lens[gi]], orr=orr[gi, :lens[gi]], err=err,
+                block_k=block_k, num_blocks=NB, batch=batch)
+            for gi in range(G)
+        ]
+        return results if grouped else results[0]
+
+    return run
+
+
+def replay_local_rle(ops, capacity: int, **kw):
+    """One-shot convenience wrapper over ``make_replayer_rle``."""
+    return make_replayer_rle(ops, capacity, **kw)()
+
+
+def expand_runs(res: RleResult, doc_index: int = 0) -> np.ndarray:
+    """Run rows -> per-char ±(order+1) column in document order (the
+    ``FlatDoc.signed`` layout), host-side numpy."""
+    res.check()
+    K = res.block_k
+    ordc = np.asarray(res.ordp)[:, doc_index]
+    lenc = np.asarray(res.lenp)[:, doc_index]
+    blk = np.asarray(res.blkord)[:, doc_index]
+    rows = np.asarray(res.rows)[:, doc_index]
+    nlog = int(np.asarray(res.meta)[0, doc_index])
+    o_parts, l_parts = [], []
+    for l in range(nlog):
+        b, r = int(blk[l]), int(rows[l])
+        o_parts.append(ordc[b * K: b * K + r])
+        l_parts.append(lenc[b * K: b * K + r])
+    if not o_parts:
+        return np.zeros(0, np.int32)
+    o = np.concatenate(o_parts).astype(np.int64)
+    ln = np.concatenate(l_parts).astype(np.int64)
+    assert (ln > 0).all(), "occupied run with non-positive length"
+    reps = ln
+    total = int(reps.sum())
+    starts = np.abs(o)
+    sign = np.sign(o)
+    base = np.repeat(starts, reps)
+    within = np.arange(total) - np.repeat(np.cumsum(reps) - reps, reps)
+    return (np.repeat(sign, reps) * (base + within)).astype(np.int32)
+
+
+def rle_to_flat(
+    ops: OpTensors,
+    res: RleResult,
+    capacity: int | None = None,
+    order_capacity: int | None = None,
+    doc_index: int = 0,
+) -> FlatDoc:
+    """Kernel result -> a standard ``FlatDoc`` (one doc of the batch):
+    expand runs to char rows, prefill the by-order logs, then merge the
+    kernel's per-op local origins (run heads; the in-run chain is the
+    compile-time prefill, `span.rs:24-28`)."""
+    flat = expand_runs(res, doc_index)
+    n = len(flat)
+    if capacity is None:
+        capacity = max(2 << max(n - 1, 5).bit_length(), n)
+    doc = make_flat_doc(capacity, order_capacity)
+    doc = prefill_logs(doc, ops)
+    ol_log = np.array(doc.ol_log)
+    or_log = np.array(doc.or_log)
+    starts = np.asarray(ops.ins_order_start, dtype=np.int64)
+    ilens = np.asarray(ops.ins_len, dtype=np.int64)
+    ol_np = np.asarray(res.ol)[:, doc_index]
+    or_np = np.asarray(res.orr)[:, doc_index]
+    for st, il, left, right in zip(starts, ilens, ol_np, or_np):
+        if il > 0:
+            ol_log[st] = left
+            or_log[st: st + il] = right
+
+    signed_col = np.zeros(capacity, np.int32)
+    signed_col[:n] = flat
+    advance = int(np.asarray(ops.order_advance, dtype=np.int64).sum())
+    return dataclasses.replace(
+        doc,
+        signed=jnp.asarray(signed_col),
+        ol_log=jnp.asarray(ol_log),
+        or_log=jnp.asarray(or_log),
+        n=jnp.asarray(n, I32),
+        next_order=jnp.asarray(advance, U32),
+    )
