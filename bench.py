@@ -291,50 +291,85 @@ def cfg_northstar(args):
 
 
 def cfg_1_cpu(args):
-    """Config 1: single-doc full-trace replay on the CPU reference path."""
+    """Config 1: single-doc full-trace replay on the CPU reference path,
+    plus the text-only rope lower bound (`benches/ropey.rs:12-38`)."""
+    from text_crdt_rust_tpu.models.native import rope_replay
+
     data = load_testing_data(trace_path("automerge-paper"))
     patches = flatten_patches(data)
-    t0 = time.perf_counter()
     base_ops, got = native_replay(patches)
     wall = len(patches) / base_ops
-    del t0
-    return make_row("config1_automerge_paper_cpu", "native-cpp",
-                    len(patches), 1, wall, len(patches), 0, base_ops,
-                    got == data.end_content)
+    crdt_row = make_row("config1_automerge_paper_cpu", "native-cpp",
+                        len(patches), 1, wall, len(patches), 0, base_ops,
+                        got == data.end_content)
+
+    # Pre-convert once: list->ndarray conversion is ~15x the replay
+    # itself and must not pollute the timed region.
+    pos = np.asarray([p.pos for p in patches], np.uint32)
+    dels = np.asarray([p.del_len for p in patches], np.uint32)
+    il = np.asarray([len(p.ins_content) for p in patches], np.uint32)
+    cps = np.frombuffer("".join(p.ins_content for p in patches)
+                        .encode("utf-32-le"), np.uint32)
+    _n, content = rope_replay(pos, dels, il, cps)  # warm + verify
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        rope_replay(pos, dels, il, cps, want_content=False)
+        best = min(best, time.perf_counter() - t0)
+    rope_row = make_row("config1_rope_text_only_lower_bound", "gap-buffer",
+                        len(patches), 1, best, len(patches), 0,
+                        len(patches) / best, content == data.end_content,
+                        note="no CRDT metadata; the bound CRDT rows are "
+                             "judged against (benches/ropey.rs)")
+    return [crdt_row, rope_row]
+
+
+def _compile_rle(patches, lmax_cap=512):
+    """Merged-stream compile + sim-sized run capacity for the rle engine.
+    Long inserts chunk at ``lmax_cap``; the in-kernel append-merge fuses
+    the chained chunks back into one device run."""
+    from text_crdt_rust_tpu.ops import rle as R
+
+    merged = B.merge_patches(patches)
+    lmax = min(max([len(p.ins_content) for p in merged] + [1]), lmax_cap)
+    ops, _ = B.compile_local_patches(merged, lmax=lmax, dmax=None)
+    peak, _final = R.simulate_run_rows(merged)
+    capacity = ((int(peak * 2.5) + 255) // 256) * 256
+    return ops, max(capacity, 512)
 
 
 def cfg_2(args):
-    """Config 2: random_edits stream, identical docs in the lane dim."""
-    from text_crdt_rust_tpu.ops import blocked as BL
-    from text_crdt_rust_tpu.ops import blocked_hbm as BH
+    """Config 2: random_edits stream, identical docs in the lane dim.
+
+    Random-position edits barely merge (factor ~1) — this config is the
+    fragmentation stress: runs stay short, so it measures the rle
+    engine's splice/split machinery, not the merge win.
+    """
+    from text_crdt_rust_tpu.ops import rle as R
 
     steps = 2000 if args.smoke else 20000
-    batch = 64 if args.smoke else 1024
+    batch = args.batch
     patches, content = random_patches(random.Random(42), steps)
-    ops, _ = B.compile_local_patches(patches, lmax=8, dmax=8)
-    ins_total = sum(len(p.ins_content) for p in patches)
-    capacity = 2 << int(np.ceil(np.log2(max(ins_total, 256))))
-    block_k = min(512, capacity // 2)
     base_ops, base_str = native_replay(patches)
     assert base_str == content
 
-    run = BH.make_replayer_hbm(ops, capacity=capacity, batch=batch,
-                               block_k=block_k,
-                               chunk=128 if args.smoke else 1024,
-                               interpret=args.interpret)
-    hbm = (2 * capacity + block_k) * batch * 4
+    ops, capacity = _compile_rle(patches)
+    run = R.make_replayer_rle(ops, capacity=capacity, batch=batch,
+                              block_k=256,
+                              chunk=128 if args.smoke else 1024,
+                              interpret=args.interpret)
+    hbm = 2 * capacity * batch * 4 + 2 * ops.num_steps * batch * 4
     res, wall, dist = time_run(run, args.reps)
-    got = SA.to_string(BL.blocked_to_flat(ops, res))
-    return make_row("config2_random_edits_identical_docs", "hbm",
+    got = SA.to_string(R.rle_to_flat(ops, res))
+    return make_row("config2_random_edits_identical_docs", "rle",
                     len(patches), batch, wall, ops.num_steps, hbm,
                     base_ops, got == content, **dist)
 
 
 def cfg_3(args):
     """Config 3: ragged mixed corpus (rustcode + sveltecomponent) as
-    divergent doc groups on the HBM engine's grid dimension."""
-    from text_crdt_rust_tpu.ops import blocked as BL
-    from text_crdt_rust_tpu.ops import blocked_hbm as BH
+    divergent doc groups on the rle engine's grid dimension."""
+    from text_crdt_rust_tpu.ops import rle as R
 
     names = ("rustcode", "sveltecomponent")
     datas = [load_testing_data(trace_path(n)) for n in names]
@@ -342,13 +377,13 @@ def cfg_3(args):
     if args.smoke:
         all_patches = [p[:400] for p in all_patches]
     opses, wants = [], []
-    for p in all_patches:
-        ops, _ = B.compile_local_patches(p, lmax=16, dmax=16)
+    capacity = 512
+    for p, d in zip(all_patches, datas):
+        ops, cap = _compile_rle(p)
         opses.append(ops)
-        wants.append(expected_content(p))
-    ins_max = max(sum(len(p.ins_content) for p in ps) for ps in all_patches)
-    capacity = 2 << int(np.ceil(np.log2(max(ins_max, 256))))
-    block_k = min(512, capacity // 2)
+        capacity = max(capacity, cap)
+        wants.append(d.end_content if not args.smoke else
+                     expected_content(p))
 
     base_total = 0.0
     for ps, want in zip(all_patches, wants):
@@ -357,20 +392,19 @@ def cfg_3(args):
         base_total += ops_s
     base_avg = base_total / len(all_patches)
 
-    run = BH.make_replayer_hbm(opses, capacity=capacity,
-                               batch=args.batch,
-                               block_k=block_k,
-                               chunk=128 if args.smoke else 1024,
-                               interpret=args.interpret)
-    hbm = (len(opses) + 1) * capacity * args.batch * 4
+    run = R.make_replayer_rle(opses, capacity=capacity,
+                              batch=args.batch, block_k=256,
+                              chunk=128 if args.smoke else 1024,
+                              interpret=args.interpret)
+    hbm = 2 * len(opses) * capacity * args.batch * 4
     results, wall, dist = time_run(run, args.reps)
     ok = True
     for ops, res, want in zip(opses, results, wants):
-        got = SA.to_string(BL.blocked_to_flat(ops, res))
+        got = SA.to_string(R.rle_to_flat(ops, res))
         ok = ok and (got == want)
     n_ops = sum(len(p) for p in all_patches)
-    steps = max(o.num_steps for o in opses) * len(opses)
-    return make_row("config3_ragged_mixed_corpus", "hbm-groups", n_ops,
+    steps = sum(o.num_steps for o in opses)
+    return make_row("config3_ragged_mixed_corpus", "rle-groups", n_ops,
                     args.batch, wall, steps, hbm, base_avg, ok,
                     groups=list(names), **dist)
 
@@ -495,11 +529,12 @@ def _continue_patches(rng, content, steps, ins_prob):
 
 
 def cfg_kevin(args):
-    """kevin (`benches/yjs.rs:51-62`): 5M single-char prepends. Native
-    engine runs the full 5M; the TPU row runs an honestly-labeled prefix
-    (the global rebalance degrades on the pure-prepend worst case)."""
-    from text_crdt_rust_tpu.ops import blocked as BL
-    from text_crdt_rust_tpu.ops import blocked_hbm as BH
+    """kevin (`benches/yjs.rs:51-62`): 5M single-char prepends on the
+    native engine; the TPU row runs 1M prepends on the HBM-state RLE
+    engine, whose logical-block splits amortize the pure-prepend worst
+    case (no global rebalance — the round-2 blocker, PERF.md §3)."""
+    from text_crdt_rust_tpu.ops import rle as R
+    from text_crdt_rust_tpu.ops import rle_hbm as RH
 
     n_native = 50_000 if args.smoke else 5_000_000
     from text_crdt_rust_tpu.models.native import NativeListCRDT
@@ -518,21 +553,28 @@ def cfg_kevin(args):
                        best, n_native, 0, n_native / best,
                        len(doc) == n_native)
 
-    n_tpu = 2048 if args.smoke else 65_536
+    n_tpu = 2048 if args.smoke else 1_000_000
     patches = [TestPatch(0, 0, " ")] * n_tpu
-    ops, _ = B.compile_local_patches(patches, lmax=4, dmax=4)
-    capacity = 2 * n_tpu
-    run = BH.make_replayer_hbm(ops, capacity=capacity, batch=args.batch,
-                               block_k=min(512, capacity // 2),
-                               chunk=128 if args.smoke else 1024,
-                               interpret=args.interpret)
+    ops, _ = B.compile_local_patches(patches, lmax=1, dmax=None)
+    # One run row per prepend (runs cannot merge backwards); splits leave
+    # blocks half full, so size ~2.1x rows.
+    block_k = 64 if args.smoke else 512
+    capacity = ((int(n_tpu * 2.1) + block_k - 1) // block_k) * block_k
+    run = RH.make_replayer_rle_hbm(ops, capacity=capacity,
+                                   batch=args.batch, block_k=block_k,
+                                   chunk=128 if args.smoke else 1024,
+                                   interpret=args.interpret)
     res, wall, dist = time_run(run, 1)
-    got_len = int(np.asarray(
-        BL.blocked_to_flat(ops, res).n))
-    tpu_row = make_row(f"kevin_tpu_{n_tpu}", "hbm", n_tpu, args.batch,
+    flat = R.expand_runs(res)
+    got_len = len(flat)
+    # Prepends reverse insertion order: orders must read N-1..0.
+    order_ok = got_len == n_tpu and bool(
+        (flat == np.arange(n_tpu, 0, -1, dtype=np.int32)).all())
+    tpu_row = make_row(f"kevin_tpu_{n_tpu}", "rle-hbm", n_tpu, args.batch,
                        wall, ops.num_steps,
                        2 * capacity * args.batch * 4,
-                       n_native / best, got_len == n_tpu, **dist)
+                       n_native / best, got_len == n_tpu and order_ok,
+                       **dist)
     return [cpu_row, tpu_row]
 
 

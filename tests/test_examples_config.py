@@ -1,0 +1,116 @@
+"""CLI drivers (`examples/simple.rs` / `stats.rs` analogs), the config
+layer, the rope text-only baseline, and the batched FlatDoc checkpoint
+(the config-5 resync path that r2 shipped broken — save_flat_doc crashed
+on any stack_docs batch)."""
+import numpy as np
+import pytest
+
+from text_crdt_rust_tpu.config import EngineConfig, SoakConfig, StatsConfig
+from text_crdt_rust_tpu.ops import span_arrays as SA
+from text_crdt_rust_tpu.utils.checkpoint import load_flat_doc, save_flat_doc
+
+
+class TestConfigLayer:
+    def test_soak_from_args(self):
+        cfg = SoakConfig.from_args(["--edits", "500", "--seed", "3",
+                                    "--oracle", "100"])
+        assert (cfg.edits, cfg.seed, cfg.oracle_steps) == (500, 3, 100)
+
+    def test_stats_from_args(self):
+        cfg = StatsConfig.from_args(["--trace", "rustcode"])
+        assert cfg.trace == "rustcode" and cfg.engine == "native"
+
+    def test_engine_defaults(self):
+        cfg = EngineConfig()
+        assert cfg.engine == "rle" and cfg.batch == 128
+
+
+class TestSoakCli:
+    def test_small_soak_runs(self, capsys):
+        from text_crdt_rust_tpu.examples.soak import main
+
+        rc = main(["--edits", "3000", "--oracle", "300", "--seed", "11"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "oracle prefix OK" in out
+        assert "content OK" in out
+
+
+class TestStatsCli:
+    @pytest.mark.parametrize("engine", ["native", "oracle"])
+    def test_stats_runs(self, engine, capsys):
+        from text_crdt_rust_tpu.examples.stats import main
+
+        rc = main(["--trace", "sveltecomponent", "--engine", engine])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "final content OK" in out
+        assert "merged spans" in out
+
+
+class TestRopeBaseline:
+    def test_rope_matches_splice_oracle(self):
+        from text_crdt_rust_tpu.models.native import rope_replay
+        from text_crdt_rust_tpu.utils.randedit import random_patches
+        import random
+
+        patches, content = random_patches(random.Random(5), 400)
+        pos = [p.pos for p in patches]
+        dels = [p.del_len for p in patches]
+        il = [len(p.ins_content) for p in patches]
+        cps = np.frombuffer("".join(p.ins_content for p in patches)
+                            .encode("utf-32-le"), np.uint32)
+        n, got = rope_replay(pos, dels, il, cps)
+        assert got == content
+        assert n == len(content)
+
+    def test_rope_growth_with_delete_insert_patch(self):
+        # Regression (r3 review): a patch that deletes AND inserts while
+        # forcing buffer growth used the pre-delete live count, injecting
+        # del_len NUL codepoints at the gap.
+        from text_crdt_rust_tpu.models.native import rope_replay
+
+        cps = np.frombuffer(("a" * 4096 + "b" * 10).encode("utf-32-le"),
+                            np.uint32)
+        n, content = rope_replay([0, 0], [0, 2], [4096, 10], cps)
+        assert n == 4104
+        assert content == "b" * 10 + "a" * 4094
+
+    def test_rope_rejects_bad_patch(self):
+        from text_crdt_rust_tpu.models.native import rope_replay
+
+        with pytest.raises(RuntimeError, match="out of range"):
+            rope_replay([5], [0], [1], np.asarray([65], np.uint32))
+
+
+class TestBatchedCheckpoint:
+    def test_roundtrip_batch(self, tmp_path):
+        docs = SA.stack_docs(SA.make_flat_doc(64), 4)
+        path = str(tmp_path / "batch.npz")
+        save_flat_doc(docs, path)
+        back = load_flat_doc(path)
+        assert back.signed.shape == docs.signed.shape
+        assert back.n.shape == docs.n.shape
+        np.testing.assert_array_equal(np.asarray(back.signed),
+                                      np.asarray(docs.signed))
+
+    def test_roundtrip_unbatched(self, tmp_path):
+        doc = SA.make_flat_doc(64)
+        path = str(tmp_path / "one.npz")
+        save_flat_doc(doc, path)
+        back = load_flat_doc(path)
+        assert back.n.shape == ()
+
+
+class TestSimulateRunRows:
+    def test_matches_trace_measurement(self):
+        from text_crdt_rust_tpu.ops import batch as B
+        from text_crdt_rust_tpu.ops.rle import simulate_run_rows
+        from text_crdt_rust_tpu.utils.testdata import (
+            flatten_patches, load_testing_data, trace_path)
+
+        data = load_testing_data(trace_path("sveltecomponent"))
+        merged = B.merge_patches(flatten_patches(data))
+        peak, final = simulate_run_rows(merged)
+        assert final == 7022  # measured once, pinned (r3 PERF.md)
+        assert peak == final

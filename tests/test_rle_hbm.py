@@ -1,0 +1,147 @@
+"""HBM-state RLE engine vs the flat engine and string oracle.
+
+Same differential battery as ``test_rle_engine`` (the two engines share
+the in-block math by construction) plus the window-cache specifics: tiny
+blocks force splits AND window misses on nearly every op, far-jump edits
+force write-back/fetch churn, and the kevin shape pins the
+prepend-amortization this engine exists for."""
+import random
+
+import numpy as np
+import pytest
+
+from text_crdt_rust_tpu.ops import batch as B
+from text_crdt_rust_tpu.ops import flat as F
+from text_crdt_rust_tpu.ops import rle as R
+from text_crdt_rust_tpu.ops import rle_hbm as RH
+from text_crdt_rust_tpu.ops import span_arrays as SA
+from text_crdt_rust_tpu.utils.testdata import (
+    TestPatch,
+    flatten_patches,
+    load_testing_data,
+    trace_path,
+)
+
+from test_device_flat import random_patches
+
+
+def run_hbm(patches, capacity, block_k, merge=True, chunk=128):
+    plist = B.merge_patches(patches) if merge else patches
+    lmax = max([len(p.ins_content) for p in plist] + [1])
+    ops, _ = B.compile_local_patches(plist, lmax=lmax, dmax=None)
+    res = RH.replay_local_rle_hbm(ops, capacity=capacity, batch=8,
+                                  block_k=block_k, chunk=chunk,
+                                  interpret=True)
+    return ops, R.rle_to_flat(ops, res)
+
+
+def ref_doc(patches, capacity=1024):
+    ops, _ = B.compile_local_patches(patches, lmax=16, dmax=None)
+    return F.apply_ops(SA.make_flat_doc(capacity), ops)
+
+
+class TestRleHbmReplay:
+    def test_smoke(self):
+        patches = [TestPatch(0, 0, "hello world"), TestPatch(5, 0, ","),
+                   TestPatch(2, 3, "LLO"), TestPatch(0, 1, "H")]
+        _, doc = run_hbm(patches, capacity=64, block_k=8)
+        ref = ref_doc(patches, 64)
+        assert SA.to_string(doc) == SA.to_string(ref) == "HeLLO, world"
+        assert SA.doc_spans(doc) == SA.doc_spans(ref)
+
+    @pytest.mark.parametrize("seed", [7, 11, 99])
+    @pytest.mark.parametrize("merge", [True, False])
+    def test_random_vs_flat(self, seed, merge):
+        rng = random.Random(seed)
+        patches, content = random_patches(rng, 80)
+        _, doc = run_hbm(patches, capacity=256, block_k=8, merge=merge)
+        ref = ref_doc(patches, 512)
+        assert SA.to_string(doc) == SA.to_string(ref) == content
+        assert SA.doc_spans(doc) == SA.doc_spans(ref)
+
+    def test_kevin_shape_prepends(self):
+        # The engine's raison d'etre: pure prepends split slot 0 over and
+        # over; the kept half stays cached (no miss), the logical order
+        # must keep the reversed doc order exact.
+        patches = [TestPatch(0, 0, "ab") for _ in range(60)]
+        _, doc = run_hbm(patches, capacity=256, block_k=8, merge=False)
+        ref = ref_doc(patches, 256)
+        assert SA.to_string(doc) == SA.to_string(ref) == "ab" * 60
+        assert SA.doc_spans(doc) == SA.doc_spans(ref)
+
+    def test_far_jump_window_churn(self):
+        # Alternating ends: nearly every op is a window miss (write-back
+        # + fetch) and boundary inserts hit the next-slot DMA peek.
+        patches = [TestPatch(0, 0, "abcdefgh")]
+        for k in range(12):
+            patches.append(TestPatch(0, 0, "xy"))
+            patches.append(TestPatch(8 + 2 * k, 0, "pq"))
+        _, doc = run_hbm(patches, capacity=128, block_k=8, merge=False)
+        ref = ref_doc(patches, 128)
+        assert SA.to_string(doc) == SA.to_string(ref)
+        assert SA.doc_spans(doc) == SA.doc_spans(ref)
+
+    def test_delete_spanning_blocks(self):
+        patches = [TestPatch(0, 0, "ab") for _ in range(24)]
+        patches.append(TestPatch(2, 40, ""))
+        _, doc = run_hbm(patches, capacity=128, block_k=8, merge=False)
+        ref = ref_doc(patches, 128)
+        assert SA.to_string(doc) == SA.to_string(ref)
+        assert SA.doc_spans(doc) == SA.doc_spans(ref)
+
+    @pytest.mark.slow
+    def test_trace_prefix(self):
+        data = load_testing_data(trace_path("automerge-paper"))
+        patches = flatten_patches(data)[:400]
+        _, doc = run_hbm(patches, capacity=256, block_k=16)
+        ref = ref_doc(patches, 1024)
+        assert SA.to_string(doc) == SA.to_string(ref)
+        assert SA.doc_spans(doc) == SA.doc_spans(ref)
+
+    def test_block_exhaustion_flagged(self):
+        patches = [TestPatch(0, 0, "ab") for _ in range(40)]
+        ops, _ = B.compile_local_patches(patches, lmax=2, dmax=None)
+        res = RH.replay_local_rle_hbm(ops, capacity=16, batch=8, block_k=8,
+                                      chunk=128, interpret=True)
+        with pytest.raises(RuntimeError, match="out of blocks"):
+            res.check()
+
+    def test_groups_divergent(self):
+        rng = random.Random(404)
+        opses, contents = [], []
+        for gi in range(3):
+            patches, content = random_patches(rng, 40 + 10 * gi)
+            merged = B.merge_patches(patches)
+            lmax = max(len(p.ins_content) for p in merged if p.ins_content)
+            ops, _ = B.compile_local_patches(merged, lmax=lmax, dmax=None)
+            opses.append(ops)
+            contents.append(content)
+        run = RH.make_replayer_rle_hbm(opses, capacity=256, batch=8,
+                                       block_k=8, chunk=128, interpret=True)
+        results = run()
+        for ops, res, content in zip(opses, results, contents):
+            assert SA.to_string(R.rle_to_flat(ops, res)) == content
+
+
+class TestVsVmemEngine:
+    """Bit-equality of the two RLE engines on the same stream (shared
+    math — any drift is a bug in the window/index plumbing)."""
+
+    @pytest.mark.parametrize("seed", [3, 21])
+    def test_equal_state(self, seed):
+        rng = random.Random(seed)
+        patches, _content = random_patches(rng, 100)
+        merged = B.merge_patches(patches)
+        lmax = max([len(p.ins_content) for p in merged] + [1])
+        ops, _ = B.compile_local_patches(merged, lmax=lmax, dmax=None)
+        res_v = R.replay_local_rle(ops, capacity=256, batch=8, block_k=8,
+                                   chunk=128, interpret=True)
+        res_h = RH.replay_local_rle_hbm(ops, capacity=256, batch=8,
+                                        block_k=8, chunk=128,
+                                        interpret=True)
+        np.testing.assert_array_equal(R.expand_runs(res_v),
+                                      R.expand_runs(res_h))
+        np.testing.assert_array_equal(np.asarray(res_v.ol),
+                                      np.asarray(res_h.ol))
+        np.testing.assert_array_equal(np.asarray(res_v.orr),
+                                      np.asarray(res_h.orr))

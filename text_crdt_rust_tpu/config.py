@@ -1,0 +1,103 @@
+"""Framework configuration layer (SURVEY §5 config row; r2 verdict A6).
+
+The reference's knobs are compile-time consts and cargo features
+(`Cargo.toml:39-41`, `range_tree/mod.rs:29-39`, `split_list/mod.rs:12-13`);
+a JAX framework needs runtime configuration. One dataclass per surface,
+a single source of defaults, and ``from_args`` parsers so every CLI
+(bench, examples) shares the same knobs instead of growing private
+argparse forests.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Optional, Sequence
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    """Device-engine knobs shared by the replay engines."""
+
+    engine: str = "rle"        # rle | blocked | hbm | flat
+    batch: int = 128           # docs in the lane dim (128 = one lane tile;
+    #                            larger crashes Mosaic today, PERF.md §1)
+    block_k: int = 256         # rows per block (rle: RUN rows)
+    chunk: int = 1024          # ops per grid step (TPU wants %1024)
+    capacity: int = 0          # state rows; 0 = per-workload default
+    lmax_cap: int = 512        # insert-chunk cap when compiling merged ops
+    interpret: bool = False    # pallas interpreter (CPU logic checks)
+
+    def add_args(self, ap: argparse.ArgumentParser) -> None:
+        ap.add_argument("--engine", default=self.engine,
+                        choices=("rle", "blocked", "hbm", "flat"))
+        ap.add_argument("--batch", type=int, default=self.batch)
+        ap.add_argument("--block-k", type=int, default=self.block_k)
+        ap.add_argument("--chunk", type=int, default=self.chunk)
+        ap.add_argument("--capacity", type=int, default=self.capacity)
+        ap.add_argument("--interpret", action="store_true",
+                        default=self.interpret)
+
+
+@dataclasses.dataclass
+class MeshConfig:
+    """Multi-chip sharding shape (``parallel.make_mesh``)."""
+
+    n_devices: int = 8
+    dp: int = 0                # 0 = derive: n_devices // sp
+    sp: int = 1                # sequence/span-parallel axis
+
+    def resolved(self) -> tuple:
+        dp = self.dp or (self.n_devices // max(self.sp, 1))
+        return dp, self.sp
+
+
+@dataclasses.dataclass
+class StreamConfig:
+    """Streaming-apply loop (config 5 shape)."""
+
+    resync_every: int = 1      # chunks between host<->device resyncs
+    checkpoint_dir: Optional[str] = None
+
+
+@dataclasses.dataclass
+class SoakConfig:
+    """``examples.soak`` — the `examples/simple.rs:14-49` driver."""
+
+    edits: int = 1_000_000
+    seed: int = 7
+    oracle_steps: int = 2_000  # per-step differential-oracle prefix
+    detailed: bool = False
+
+    @classmethod
+    def from_args(cls, argv: Optional[Sequence[str]] = None) -> "SoakConfig":
+        d = cls()
+        ap = argparse.ArgumentParser(description=__doc__)
+        ap.add_argument("--edits", type=int, default=d.edits)
+        ap.add_argument("--seed", type=int, default=d.seed)
+        ap.add_argument("--oracle", type=int, default=d.oracle_steps,
+                        dest="oracle_steps",
+                        help="per-step-checked oracle prefix (0 = skip)")
+        ap.add_argument("--detailed", action="store_true")
+        a = ap.parse_args(argv)
+        return cls(edits=a.edits, seed=a.seed,
+                   oracle_steps=a.oracle_steps, detailed=a.detailed)
+
+
+@dataclasses.dataclass
+class StatsConfig:
+    """``examples.stats`` — the `examples/stats.rs:39-73` driver."""
+
+    trace: str = "automerge-paper"
+    engine: str = "native"     # native | oracle
+    detailed: bool = False
+
+    @classmethod
+    def from_args(cls, argv: Optional[Sequence[str]] = None) -> "StatsConfig":
+        d = cls()
+        ap = argparse.ArgumentParser(description=__doc__)
+        ap.add_argument("--trace", default=d.trace)
+        ap.add_argument("--engine", default=d.engine,
+                        choices=("native", "oracle"))
+        ap.add_argument("--detailed", action="store_true")
+        a = ap.parse_args(argv)
+        return cls(trace=a.trace, engine=a.engine, detailed=a.detailed)

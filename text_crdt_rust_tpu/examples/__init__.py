@@ -1,0 +1,7 @@
+"""CLI entry points (the `examples/` drivers of the reference):
+
+- ``python -m text_crdt_rust_tpu.examples.soak`` — 1M seeded random
+  edits + stats (`examples/simple.rs:14-49`).
+- ``python -m text_crdt_rust_tpu.examples.stats`` — trace replay with
+  memory/compaction report (`examples/stats.rs:39-73`).
+"""

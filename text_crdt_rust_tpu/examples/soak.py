@@ -1,0 +1,107 @@
+"""Soak driver: seeded random edits, dual-oracle checked, with stats.
+
+The `examples/simple.rs:14-49` analog: 1M seeded random edits against a
+rope oracle, then stats. Here the edits replay on the native C++ engine
+(single call), final content is verified against the text-only gap-buffer
+replay (`benches/ropey.rs` analog — an independent code path), and the
+first ``--oracle`` edits additionally replay step-by-step through the
+Python oracle with per-step content equality + ``check()`` invariants
+(the `make_random_change`/`doc.check()` loop of `doc.rs:544-587`).
+
+Usage: ``python -m text_crdt_rust_tpu.examples.soak [--edits N] [--seed S]``
+"""
+from __future__ import annotations
+
+import argparse
+import random
+import sys
+import time
+
+import numpy as np
+
+from ..common import LocalOp
+from ..config import SoakConfig
+
+
+def make_edits(rng: random.Random, n: int):
+    """Seeded random edit stream (the `make_random_change` distribution:
+    inserts of 1-4 chars vs deletes of 1-4, position uniform)."""
+    pos = np.zeros(n, np.uint32)
+    dels = np.zeros(n, np.uint32)
+    ilens = np.zeros(n, np.uint32)
+    chars = []
+    content_len = 0
+    alphabet = "abcdefghijklmnop "
+    for i in range(n):
+        if content_len == 0 or rng.random() < 0.55:
+            p = rng.randint(0, content_len)
+            ins = "".join(rng.choice(alphabet)
+                          for _ in range(rng.randint(1, 4)))
+            pos[i] = p
+            ilens[i] = len(ins)
+            chars.append(ins)
+            content_len += len(ins)
+        else:
+            p = rng.randint(0, content_len - 1)
+            span = min(rng.randint(1, 4), content_len - p)
+            pos[i] = p
+            dels[i] = span
+            content_len -= span
+    cps = np.frombuffer("".join(chars).encode("utf-32-le"), dtype=np.uint32)
+    return pos, dels, ilens, cps
+
+
+def main(argv=None) -> int:
+    cfg = SoakConfig.from_args(argv)
+    rng = random.Random(cfg.seed)
+    print(f"soak: {cfg.edits} seeded random edits (seed={cfg.seed})")
+    pos, dels, ilens, cps = make_edits(rng, cfg.edits)
+
+    # Step-by-step differential oracle on a prefix (`doc.rs:571-587`).
+    if cfg.oracle_steps:
+        from ..models.oracle import ListCRDT
+
+        doc = ListCRDT(capacity=256)
+        agent = doc.get_or_create_agent_id("soak")
+        content = ""
+        off = 0
+        for i in range(min(cfg.oracle_steps, cfg.edits)):
+            il = int(ilens[i])
+            ins = (cps[off:off + il].tobytes().decode("utf-32-le")
+                   if il else "")
+            off += il
+            p, d = int(pos[i]), int(dels[i])
+            doc.apply_local_txn(agent, [LocalOp(p, ins, d)])
+            content = content[:p] + ins + content[p + d:]
+            assert doc.to_string() == content, f"oracle diverged at {i}"
+        doc.check()
+        print(f"  oracle prefix OK ({min(cfg.oracle_steps, cfg.edits)} "
+              f"steps, per-step checked)")
+
+    # Full run on the native engine.
+    from ..models.native import NativeListCRDT, rope_replay
+
+    ndoc = NativeListCRDT()
+    agent = ndoc.get_or_create_agent_id("soak")
+    t0 = time.perf_counter()
+    ndoc.replay_trace(agent, pos, dels, ilens, cps)
+    wall = time.perf_counter() - t0
+    print(f"  native replay: {cfg.edits / wall:,.0f} edits/s "
+          f"({wall * 1e3:.0f}ms)")
+
+    # Independent text-only oracle (different code path entirely).
+    n, content = rope_replay(pos, dels, ilens, cps)
+    got = ndoc.to_string()
+    assert got == content, "native engine diverged from gap-buffer oracle"
+    print(f"  content OK: {n} chars, {ndoc.num_spans()} spans "
+          f"(compaction {ndoc.raw_len() / max(1, ndoc.num_spans()):.1f} "
+          f"items/span)")
+
+    from ..utils.metrics import print_stats
+
+    print_stats(ndoc, detailed=cfg.detailed)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,70 @@
+"""Stats driver: trace replay + memory/compaction report.
+
+The `examples/stats.rs:39-73` analog: replay a shipped editing trace,
+assert the final content, and print span/memory/throughput statistics
+(the `print_stats` + `TracingAlloc` report, `stats.rs:56-71`).
+
+Usage: ``python -m text_crdt_rust_tpu.examples.stats [--trace NAME]
+[--engine native|oracle] [--detailed]``
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+from ..config import StatsConfig
+from ..utils.testdata import flatten_patches, load_testing_data, trace_path
+
+
+def main(argv=None) -> int:
+    cfg = StatsConfig.from_args(argv)
+    data = load_testing_data(trace_path(cfg.trace))
+    patches = flatten_patches(data)
+    n_chars = sum(p.del_len + len(p.ins_content) for p in patches)
+    print(f"{cfg.trace}: {len(patches)} patches, {n_chars} CRDT ops, "
+          f"final length {len(data.end_content)}")
+
+    if cfg.engine == "native":
+        from ..models.native import NativeListCRDT
+
+        doc = NativeListCRDT()
+        agent = doc.get_or_create_agent_id("stats")
+        pos = [p.pos for p in patches]
+        dels = [p.del_len for p in patches]
+        ilens = [len(p.ins_content) for p in patches]
+        cps = np.frombuffer(
+            "".join(p.ins_content for p in patches).encode("utf-32-le"),
+            dtype=np.uint32)
+        t0 = time.perf_counter()
+        doc.replay_trace(agent, pos, dels, ilens, cps)
+        wall = time.perf_counter() - t0
+    else:
+        from ..common import LocalOp
+        from ..models.oracle import ListCRDT
+
+        doc = ListCRDT(capacity=1024)
+        agent = doc.get_or_create_agent_id("stats")
+        t0 = time.perf_counter()
+        for p in patches:
+            doc.apply_local_txn(
+                agent, [LocalOp(p.pos, p.ins_content, p.del_len)])
+        wall = time.perf_counter() - t0
+
+    got = doc.to_string()
+    ok = got == data.end_content
+    print(f"replay ({cfg.engine}): {wall * 1e3:.0f}ms = "
+          f"{len(patches) / wall:,.0f} patches/s, final content "
+          f"{'OK' if ok else 'MISMATCH'}")
+    if not ok:
+        return 1
+
+    from ..utils.metrics import print_stats
+
+    print_stats(doc, detailed=cfg.detailed)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

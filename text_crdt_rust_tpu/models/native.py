@@ -69,6 +69,10 @@ def _load():
     lib.tcr_text_utf8.argtypes = [ct.c_void_p, ct.c_char_p, u32]
     lib.tcr_replay_trace.restype = ct.c_int
     lib.tcr_replay_trace.argtypes = [ct.c_void_p, u32, u32, p32, p32, p32, p32]
+    lib.tcr_rope_replay.restype = ct.c_longlong
+    lib.tcr_rope_replay.argtypes = [u32, p32, p32, p32, p32, p32, u32]
+    lib.tcr_memory_bytes.restype = ct.c_ulonglong
+    lib.tcr_memory_bytes.argtypes = [ct.c_void_p]
     _lib = lib
     return lib
 
@@ -207,6 +211,14 @@ class NativeListCRDT:
     def raw_len(self) -> int:
         return self._lib.tcr_raw_len(self._doc)
 
+    def num_spans(self) -> int:
+        return self._lib.tcr_num_spans(self._doc)
+
+    def memory_bytes(self) -> int:
+        """Actual allocation of the document (the `alloc.rs:40-50`
+        TracingAlloc role): every live vector/map buffer."""
+        return self._lib.tcr_memory_bytes(self._doc)
+
     def get_next_order(self) -> int:
         return self._lib.tcr_next_order(self._doc)
 
@@ -251,3 +263,22 @@ class NativeListCRDT:
         a, b, c = (np.zeros(n, np.uint32) for _ in range(3))
         self._lib.tcr_get_double_deletes(self._doc, _ptr(a), _ptr(b), _ptr(c), n)
         return [(int(a[i]), int(b[i]), int(c[i])) for i in range(n)]
+
+
+def rope_replay(pos, dels, ins_lens, cps, want_content: bool = True):
+    """Text-only gap-buffer replay (`benches/ropey.rs:12-38` analog): the
+    lower bound the CRDT numbers are judged against — same edit stream,
+    zero CRDT metadata. Returns ``(final_len, content_or_None)``."""
+    lib = _load()
+    pos, dels, ins_lens, cps = map(_u32arr, (pos, dels, ins_lens, cps))
+    cap = int(cps.size) + 16
+    out = np.zeros(cap, np.uint32) if want_content else None
+    n = lib.tcr_rope_replay(len(pos), _ptr(pos), _ptr(dels), _ptr(ins_lens),
+                            _ptr(cps), _ptr(out) if want_content else None,
+                            cap if want_content else 0)
+    if n < 0:
+        raise RuntimeError("rope replay: patch out of range")
+    content = None
+    if want_content:
+        content = out[:n].tobytes().decode("utf-32-le")
+    return int(n), content

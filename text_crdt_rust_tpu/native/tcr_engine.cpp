@@ -920,6 +920,27 @@ u32 tcr_raw_len(void* d) { return ((Doc*)d)->n_raw(); }
 u32 tcr_next_order(void* d) { return ((Doc*)d)->next_order(); }
 u32 tcr_num_spans(void* d) { return ((Doc*)d)->n_spans; }
 
+// Actual allocation of the document (the `alloc.rs:40-50` role): every
+// live vector/map buffer, in bytes.
+unsigned long long tcr_memory_bytes(void* dv) {
+    Doc* d = (Doc*)dv;
+    unsigned long long b = 0;
+    b += d->nodes.capacity() * sizeof(Node);
+    b += d->order_index.size() * (sizeof(u32) + sizeof(int) + 48);  // map node
+    b += d->chars.capacity() * sizeof(u32);
+    b += d->free_nodes.capacity() * sizeof(int);
+    b += d->client_with_order.capacity() * sizeof(CwoEntry);
+    b += d->deletes.capacity() * sizeof(DelEntry);
+    b += d->double_deletes.capacity() * sizeof(DDEntry);
+    b += d->txns.capacity() * sizeof(TxnEntry);
+    b += d->frontier.capacity() * sizeof(u32);
+    for (auto& c : d->clients) {
+        b += sizeof(ClientData) + c.name.size();
+        b += c.item_orders.capacity() * sizeof(IoEntry);
+    }
+    return b;
+}
+
 int tcr_apply_local_txn(void* dv, u32 agent, u32 n_ops, const u32* pos,
                         const u32* dels, const u32* ins_lens,
                         const u32* ins_cps) {
@@ -1083,6 +1104,59 @@ int tcr_replay_trace(void* dv, u32 agent, u32 n_patches, const u32* pos,
         cp += ins_lens[i];
     }
     return 0;
+}
+
+// Text-only replay baseline (`benches/ropey.rs:12-38` analog): a gap
+// buffer of u32 codepoints — the rope stand-in that measures what the
+// same edit stream costs with NO CRDT metadata at all, the lower bound
+// CRDT numbers are judged against. Returns the final length; if `out`
+// is non-null and holds >= that many u32s, the final content is copied.
+long long tcr_rope_replay(u32 n_patches, const u32* pos, const u32* dels,
+                          const u32* ins_lens, const u32* cps,
+                          u32* out, u32 out_cap) {
+    std::vector<u32> buf(4096);
+    size_t gap_at = 0, gap_len = buf.size();  // [gap_at, gap_at+gap_len)
+    const u32* cp = cps;
+    for (u32 i = 0; i < n_patches; i++) {
+        size_t n = buf.size() - gap_len;
+        size_t p = pos[i], d = dels[i], il = ins_lens[i];
+        if (p > n || p + d > n) return -1;
+        // Move the gap to p (the rope's cursor locality: consecutive
+        // edits at nearby positions cost near-zero moves).
+        if (p < gap_at) {
+            std::memmove(buf.data() + p + gap_len, buf.data() + p,
+                         (gap_at - p) * sizeof(u32));
+            gap_at = p;
+        } else if (p > gap_at) {
+            std::memmove(buf.data() + gap_at, buf.data() + gap_at + gap_len,
+                         (p - gap_at) * sizeof(u32));
+            gap_at = p;
+        }
+        gap_len += d;  // delete = widen the gap over the removed chars
+        if (il > gap_len) {  // grow: double until the insert fits
+            size_t live = buf.size() - gap_len;  // post-delete live count
+            size_t tail = buf.size() - (gap_at + gap_len);
+            size_t need = buf.size();
+            while (need - live < il) need *= 2;
+            std::vector<u32> nb(need);
+            std::memcpy(nb.data(), buf.data(), gap_at * sizeof(u32));
+            std::memcpy(nb.data() + need - tail,
+                        buf.data() + buf.size() - tail, tail * sizeof(u32));
+            gap_len = need - live;
+            buf.swap(nb);
+        }
+        std::memcpy(buf.data() + gap_at, cp, il * sizeof(u32));
+        cp += il;
+        gap_at += il;
+        gap_len -= il;
+    }
+    size_t n = buf.size() - gap_len;
+    if (out && out_cap >= n) {
+        std::memcpy(out, buf.data(), gap_at * sizeof(u32));
+        std::memcpy(out + gap_at, buf.data() + gap_at + gap_len,
+                    (n - gap_at) * sizeof(u32));
+    }
+    return (long long)n;
 }
 
 }  // extern "C"

@@ -278,7 +278,14 @@ def _mixed_kernel(
         def body(carry):
             mask, iters = carry
             low = mask & (-mask)
-            k0 = lax.population_count(low - 1)
+            # floor(log2(low)) via scalar shifts — Mosaic has no scalar
+            # population-count (it rejected popcount(low - 1) here).
+            v = low
+            k0 = jnp.int32(0)
+            for sh in (16, 8, 4, 2, 1):
+                ge = (v >> sh) != 0
+                k0 = k0 + jnp.where(ge, sh, 0)
+                v = jnp.where(ge, v >> sh, v)
             b, _row = locate_order(t + k0)
             blk = sig[pl.ds(b * K, K), :]
             occ = blk != 0

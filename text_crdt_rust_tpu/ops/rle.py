@@ -72,12 +72,120 @@ def _shift_rows_up(x, amount, max_amount: int) -> jax.Array:
     return out
 
 
+
+def _row_scalar(arr2d, r, idx_k) -> jax.Array:
+    """Row ``r`` of a lane-replicated [K, B] value, as one scalar."""
+    return jnp.max(jnp.sum(jnp.where(idx_k == r, arr2d, 0), axis=0))
+
+
+def _locate_run(bo, bl, idx_k, r0, local):
+    """Find the run containing live char #``local`` (1-based) in a block:
+    returns ``(i_r, o_r, l_r, off)`` — row index, ±(order+1), length, and
+    the 1-based char offset within the run. The hit is a live run by
+    construction (tombstone rows don't advance the live cumsum)."""
+    lv = jnp.where(bo > 0, bl, 0)
+    cum = _cumsum_rows(lv)
+    i_r = jnp.max(jnp.sum(
+        ((cum < local) & (idx_k < r0)).astype(jnp.int32), axis=0))
+    o_r = _row_scalar(bo, i_r, idx_k)
+    l_r = _row_scalar(bl, i_r, idx_k)
+    off = local - (_row_scalar(cum, i_r, idx_k)
+                   - _row_scalar(lv, i_r, idx_k))
+    return i_r, o_r, l_r, off
+
+
+def _insert_splice(bo, bl, idx_k, p, i_r, o_r, l_r, off, il, st):
+    """In-register insert splice (`mutations.rs:17-179`): ≤3 touched rows
+    regardless of ``il``. Returns ``(no, nl, amt, mrg, is_split)`` —
+    the new block planes, rows added, and which path was taken.
+
+    The in-place merge path is device-state compaction only (an
+    order-contiguous live extension of run ``i_r``); YjsSpan merge
+    predicates live host-side — this run is raw doc order.
+    """
+    mrg = (p > 0) & (off == l_r) & ((st + 1) == (o_r + l_r))
+    is_split = (p > 0) & (off < l_r)
+    ins_at = jnp.where(p == 0, 0, i_r + 1)
+    amt = jnp.where(mrg, 0, jnp.where(is_split, 2, 1))
+    so = _shift_rows(bo, amt, 2)
+    sl = _shift_rows(bl, amt, 2)
+    no = jnp.where(idx_k < ins_at, bo, so)
+    nl = jnp.where(idx_k < ins_at, bl, sl)
+    nl = jnp.where(is_split & (idx_k == i_r), off, nl)
+    new_run = (idx_k == ins_at) & jnp.logical_not(mrg)
+    no = jnp.where(new_run, st + 1, no)
+    nl = jnp.where(new_run, il, nl)
+    tail = is_split & (idx_k == ins_at + 1)
+    no = jnp.where(tail, o_r + off, no)
+    nl = jnp.where(tail, l_r - off, nl)
+    nl = jnp.where(mrg & (idx_k == i_r), l_r + il, nl)
+    return no, nl, amt, mrg, is_split
+
+
+def _delete_block_math(bo, bl, idx_k, K, base, p, rem):
+    """One delete iteration over one block (`mutations.rs:520-570`): flip
+    fully-covered runs, split at most the two boundary runs. Returns
+    ``(no, nl, added_rows, covered)``; caller walks blocks while
+    ``covered`` hasn't reached ``rem``."""
+
+    def apply_partial(active, i_p, cs, ce, bo, bl):
+        o = _row_scalar(bo, i_p, idx_k)
+        ln = _row_scalar(bl, i_p, idx_k)
+        cs_i = _row_scalar(cs, i_p, idx_k)
+        ce_i = _row_scalar(ce, i_p, idx_k)
+        cov_i = ce_i - cs_i
+        has_head = (cs_i > 0) & active
+        has_tail = (ce_i < ln) & active
+        amt = has_head.astype(jnp.int32) + has_tail.astype(jnp.int32)
+        so = _shift_rows(bo, amt, 2)
+        sl = _shift_rows(bl, amt, 2)
+        no = jnp.where(idx_k <= i_p, bo, so)
+        nl = jnp.where(idx_k <= i_p, bl, sl)
+        # Part layout: [head?] [tombstone mid] [tail?]; the tombstone
+        # start encodes as -(o + cs) per the ±(order+1) convention.
+        p0o = jnp.where(has_head, o, -(o + cs_i))
+        p0l = jnp.where(has_head, cs_i, cov_i)
+        p1o = jnp.where(has_head, -(o + cs_i), o + ce_i)
+        p1l = jnp.where(has_head, cov_i, ln - ce_i)
+        w0 = active & (idx_k == i_p)
+        no = jnp.where(w0, p0o, no)
+        nl = jnp.where(w0, p0l, nl)
+        w1 = active & (idx_k == i_p + 1) & (amt >= 1)
+        no = jnp.where(w1, p1o, no)
+        nl = jnp.where(w1, p1l, nl)
+        w2 = active & (idx_k == i_p + 2) & (amt == 2)
+        no = jnp.where(w2, o + ce_i, no)
+        nl = jnp.where(w2, ln - ce_i, nl)
+        return no, nl, amt
+
+    lv = jnp.where(bo > 0, bl, 0)
+    cum = _cumsum_rows(lv)
+    before = base + cum - lv
+    cs = jnp.clip(p - before, 0, lv)
+    ce = jnp.clip(p + rem - before, 0, lv)
+    cov = ce - cs
+    tot = jnp.max(jnp.sum(cov, axis=0))
+    full = (cov > 0) & (cov == bl)
+    part = (cov > 0) & jnp.logical_not(full)
+    npart = jnp.max(jnp.sum(part.astype(jnp.int32), axis=0))
+    i1 = jnp.max(jnp.min(jnp.where(part, idx_k, K), axis=0))
+    i2 = jnp.max(jnp.max(jnp.where(part, idx_k, -1), axis=0))
+
+    bo = jnp.where(full, -bo, bo)
+    # Higher-index boundary first so i1's row index stays valid.
+    bo, bl, a2 = apply_partial(npart >= 1, i2, cs, ce, bo, bl)
+    bo, bl, a1 = apply_partial(npart == 2, i1, cs, ce, bo, bl)
+    return bo, bl, a1 + a2, tot
+
+
 def _rle_kernel(
-    pos_ref, dlen_ref, ilen_ref, start_ref,     # [1,CHUNK] SMEM op columns
+    pos_ref, dlen_ref, ilen_ref, start_ref,     # [CHUNK] SMEM op columns
     ol_ref, or_ref,                             # [1,CHUNK,B] VMEM outputs
-    ord_out, len_out,                           # [CAP,B] final state planes
+    ordp, lenp,                                 # [CAP,B] state planes (OUT
+                                                #   blocks used as working
+                                                #   state — halves VMEM)
     blk_out, rows_out, meta_out, err_ref,       # tables + flags
-    ordp, lenp, blkord, rws, liv, meta,         # persistent scratch
+    blkord, rws, liv, meta,                     # persistent scratch
     *, K: int, NB: int, NBL: int, CHUNK: int,
 ):
     B = ordp.shape[1]
@@ -107,9 +215,6 @@ def _rle_kernel(
 
     def slot_scalar(tbl, l):
         return _lane_scalar(jnp.where(idx_l == l, tbl[:], 0))
-
-    def row_scalar(arr2d, r):
-        return jnp.max(jnp.sum(jnp.where(idx_k == r, arr2d, 0), axis=0))
 
     def live_before_slot(l):
         return _lane_scalar(jnp.where(idx_l < l, liv[:], 0))
@@ -183,26 +288,15 @@ def _rle_kernel(
         local = p - base
         bo = ordp[pl.ds(b * K, K), :]
         bl = lenp[pl.ds(b * K, K), :]
-        lv = jnp.where(bo > 0, bl, 0)
-        cum = _cumsum_rows(lv)
-        # Run containing live char #local (1-based); a live run by
-        # construction — tombstone rows don't advance ``cum``.
-        i_r = jnp.max(jnp.sum(
-            ((cum < local) & (idx_k < r0)).astype(jnp.int32), axis=0))
-        o_r = row_scalar(bo, i_r)
-        l_r = row_scalar(bl, i_r)
-        off = local - (row_scalar(cum, i_r) - row_scalar(lv, i_r))
+        i_r, o_r, l_r, off = _locate_run(bo, bl, idx_k, r0, local)
+        no, nl, amt, _mrg, is_split = _insert_splice(
+            bo, bl, idx_k, p, i_r, o_r, l_r, off, il, st)
 
         left = jnp.where(p == 0, root_u,
                          ((o_r - 1) + (off - 1)).astype(jnp.uint32))
-        # Device-state run merge: order-contiguous live extension of run
-        # i_r compresses in place (state compaction only — YjsSpan merge
-        # predicates live host-side; this run is raw doc order).
-        mrg = (p > 0) & (off == l_r) & ((st + 1) == (o_r + l_r))
-        is_split = (p > 0) & (off < l_r)
-
-        # Raw successor (`doc.rs:452`: tombstones not skipped).
-        nxt_in_blk = row_scalar(bo, i_r + 1)  # 0 when i_r is the last row
+        # Raw successor (`doc.rs:452`: tombstones not skipped); read from
+        # the PRE-splice block.
+        nxt_in_blk = _row_scalar(bo, i_r + 1, idx_k)  # 0 past the last row
         nlog = meta[0]
         b2 = slot_scalar(blkord, jnp.minimum(l + 1, NBL - 1))
         nxt_slot_o = jnp.max(jnp.sum(jnp.where(
@@ -210,27 +304,13 @@ def _rle_kernel(
         succ_signed = jnp.where(
             i_r + 1 < r0, nxt_in_blk,
             jnp.where(l + 1 < nlog, nxt_slot_o, 0))
-        first_o = row_scalar(bo, 0)  # p == 0 successor: the raw doc head
+        first_o = _row_scalar(bo, 0, idx_k)  # p == 0: the raw doc head
         succ_p0 = jnp.where(r0 > 0, first_o, 0)
         succ = jnp.where(p == 0, succ_p0,
                          jnp.where(is_split, o_r + off, succ_signed))
         right = jnp.where(succ == 0, root_u,
                           (jnp.abs(succ) - 1).astype(jnp.uint32))
 
-        ins_at = jnp.where(p == 0, 0, i_r + 1)
-        amt = jnp.where(mrg, 0, jnp.where(is_split, 2, 1))
-        so = _shift_rows(bo, amt, 2)
-        sl = _shift_rows(bl, amt, 2)
-        no = jnp.where(idx_k < ins_at, bo, so)
-        nl = jnp.where(idx_k < ins_at, bl, sl)
-        nl = jnp.where(is_split & (idx_k == i_r), off, nl)
-        new_run = (idx_k == ins_at) & jnp.logical_not(mrg)
-        no = jnp.where(new_run, st + 1, no)
-        nl = jnp.where(new_run, il, nl)
-        tail = is_split & (idx_k == ins_at + 1)
-        no = jnp.where(tail, o_r + off, no)
-        nl = jnp.where(tail, l_r - off, nl)
-        nl = jnp.where(mrg & (idx_k == i_r), l_r + il, nl)
         ordp[pl.ds(b * K, K), :] = no
         lenp[pl.ds(b * K, K), :] = nl
         rws[pl.ds(l, 1), :] = rws[pl.ds(l, 1), :] + amt
@@ -243,38 +323,6 @@ def _rle_kernel(
         """Tombstone ``d`` live chars after live rank ``p``: per block,
         flip fully-covered runs and split at most the two boundary runs
         (`mutations.rs:520-570`; `doc.rs:311-334` fragmentation)."""
-
-        def apply_partial(active, i_p, cs, ce, bo, bl):
-            """Split partial row ``i_p`` into ≤3 parts in-register.
-            Masked no-op when ``active`` is false."""
-            o = row_scalar(bo, i_p)
-            ln = row_scalar(bl, i_p)
-            cs_i = row_scalar(cs, i_p)
-            ce_i = row_scalar(ce, i_p)
-            cov_i = ce_i - cs_i
-            has_head = (cs_i > 0) & active
-            has_tail = (ce_i < ln) & active
-            amt = has_head.astype(jnp.int32) + has_tail.astype(jnp.int32)
-            so = _shift_rows(bo, amt, 2)
-            sl = _shift_rows(bl, amt, 2)
-            no = jnp.where(idx_k <= i_p, bo, so)
-            nl = jnp.where(idx_k <= i_p, bl, sl)
-            # Part layout: [head?] [tombstone mid] [tail?]; the tombstone
-            # start encodes as -(o + cs) per the ±(order+1) convention.
-            p0o = jnp.where(has_head, o, -(o + cs_i))
-            p0l = jnp.where(has_head, cs_i, cov_i)
-            p1o = jnp.where(has_head, -(o + cs_i), o + ce_i)
-            p1l = jnp.where(has_head, cov_i, ln - ce_i)
-            w0 = active & (idx_k == i_p)
-            no = jnp.where(w0, p0o, no)
-            nl = jnp.where(w0, p0l, nl)
-            w1 = active & (idx_k == i_p + 1) & (amt >= 1)
-            no = jnp.where(w1, p1o, no)
-            nl = jnp.where(w1, p1l, nl)
-            w2 = active & (idx_k == i_p + 2) & (amt == 2)
-            no = jnp.where(w2, o + ce_i, no)
-            nl = jnp.where(w2, ln - ce_i, nl)
-            return no, nl, amt
 
         def body(carry):
             rem, iters = carry
@@ -289,26 +337,11 @@ def _rle_kernel(
             base = live_before_slot(l)
             bo = ordp[pl.ds(b * K, K), :]
             bl = lenp[pl.ds(b * K, K), :]
-            lv = jnp.where(bo > 0, bl, 0)
-            cum = _cumsum_rows(lv)
-            before = base + cum - lv
-            cs = jnp.clip(p - before, 0, lv)
-            ce = jnp.clip(p + rem - before, 0, lv)
-            cov = ce - cs
-            tot = jnp.max(jnp.sum(cov, axis=0))
-            full = (cov > 0) & (cov == bl)
-            part = (cov > 0) & jnp.logical_not(full)
-            npart = jnp.max(jnp.sum(part.astype(jnp.int32), axis=0))
-            i1 = jnp.max(jnp.min(jnp.where(part, idx_k, K), axis=0))
-            i2 = jnp.max(jnp.max(jnp.where(part, idx_k, -1), axis=0))
-
-            bo = jnp.where(full, -bo, bo)
-            # Higher-index boundary first so i1's row index stays valid.
-            bo, bl, a2 = apply_partial(npart >= 1, i2, cs, ce, bo, bl)
-            bo, bl, a1 = apply_partial(npart == 2, i1, cs, ce, bo, bl)
-            ordp[pl.ds(b * K, K), :] = bo
-            lenp[pl.ds(b * K, K), :] = bl
-            rws[pl.ds(l, 1), :] = rws[pl.ds(l, 1), :] + a1 + a2
+            no, nl, added, tot = _delete_block_math(
+                bo, bl, idx_k, K, base, p, rem)
+            ordp[pl.ds(b * K, K), :] = no
+            lenp[pl.ds(b * K, K), :] = nl
+            rws[pl.ds(l, 1), :] = rws[pl.ds(l, 1), :] + added
             liv[pl.ds(l, 1), :] = liv[pl.ds(l, 1), :] - tot
             return rem - tot, iters + 1
 
@@ -322,10 +355,10 @@ def _rle_kernel(
             err_ref[1:2, :] = jnp.ones((1, B), jnp.int32)
 
     def op_body(k, _):
-        p = pos_ref[0, k]
-        d = dlen_ref[0, k]
-        il = ilen_ref[0, k]
-        st = start_ref[0, k]
+        p = pos_ref[k]
+        d = dlen_ref[k]
+        il = ilen_ref[k]
+        st = start_ref[k]
 
         @pl.when(d > 0)
         def _():
@@ -341,8 +374,6 @@ def _rle_kernel(
 
     @pl.when(i == last)
     def _flush():
-        ord_out[:] = ordp[:]
-        len_out[:] = lenp[:]
         blk_out[:] = blkord[:][jnp.newaxis]
         rows_out[:] = rws[:][jnp.newaxis]
         row0 = lax.broadcasted_iota(jnp.int32, (1, 8, B), 1) == 0
@@ -423,15 +454,20 @@ def make_replayer_rle(
         for st in streams:
             a = np.asarray(get(st), dtype=np.int32)
             cols.append(np.pad(a, ((0, s_pad - len(a)),)))
-        return jnp.asarray(np.stack(cols))          # [G, s_pad]
+        # Flat [G*s_pad]: grouped 2-D SMEM blocks are not a legal TPU
+        # layout (block second-minor must divide by 8 or equal the
+        # array dim); 1-D chunk blocks indexed g*(s_pad//chunk)+i are.
+        return jnp.asarray(np.concatenate(cols))
 
     staged = (staged_col(lambda o: o.pos),
               staged_col(lambda o: o.del_len),
               staged_col(lambda o: o.ins_len),
               staged_col(lambda o: o.ins_order_start))
 
+    blocks_per_g = s_pad // chunk
     smem = lambda: pl.BlockSpec(
-        (1, chunk), lambda g, i: (g, i), memory_space=pltpu.SMEM)
+        (chunk,), lambda g, i: (g * blocks_per_g + i,),
+        memory_space=pltpu.SMEM)
 
     call = pl.pallas_call(
         partial(_rle_kernel, K=block_k, NB=NB, NBL=NBLp, CHUNK=chunk),
@@ -466,8 +502,6 @@ def make_replayer_rle(
             jax.ShapeDtypeStruct((8, batch), jnp.int32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((capacity, batch), jnp.int32),   # ordp
-            pltpu.VMEM((capacity, batch), jnp.int32),   # lenp
             pltpu.VMEM((NBLp, batch), jnp.int32),       # blkord
             pltpu.VMEM((NBLp, batch), jnp.int32),       # rws
             pltpu.VMEM((NBLp, batch), jnp.int32),       # liv
@@ -499,6 +533,66 @@ def make_replayer_rle(
 def replay_local_rle(ops, capacity: int, **kw):
     """One-shot convenience wrapper over ``make_replayer_rle``."""
     return make_replayer_rle(ops, capacity, **kw)()
+
+
+def simulate_run_rows(patches) -> tuple:
+    """Host dry-run of the kernel's row algebra over a (merged) patch
+    list: returns ``(peak_rows, final_rows)``. Used for capacity planning
+    — blocks fragment to ~50% after splits, so size the device capacity
+    at ~2.5x the peak. Mirrors the kernel exactly: delete = flip covered
+    runs + boundary splits; insert = append-merge / splice / 3-way split.
+    """
+    runs = []  # (order_start, char_len, live)
+    next_order = 0
+    peak = 0
+    for p in patches:
+        if p.del_len:
+            rem = p.del_len
+            before = 0
+            i = 0
+            while rem > 0 and i < len(runs):
+                o, l, live = runs[i]
+                lv = l if live else 0
+                cs = min(max(p.pos - before, 0), lv)
+                ce = min(max(p.pos + rem - before, 0), lv)
+                cov = ce - cs
+                if cov > 0:
+                    parts = []
+                    if cs > 0:
+                        parts.append((o, cs, True))
+                    parts.append((o + cs, cov, False))
+                    if ce < l:
+                        parts.append((o + ce, l - ce, True))
+                    runs[i:i + 1] = parts
+                    i += len(parts)
+                    rem -= cov
+                else:
+                    i += 1
+                before += lv - cov
+            next_order += p.del_len
+        il = len(p.ins_content)
+        if il:
+            st = next_order
+            if p.pos == 0:
+                runs.insert(0, (st, il, True))
+            else:
+                before = 0
+                for i, (o, l, live) in enumerate(runs):
+                    lv = l if live else 0
+                    if before + lv >= p.pos:
+                        off = p.pos - before
+                        if off == l and live and st == o + l:
+                            runs[i] = (o, l + il, True)
+                        elif off == lv:
+                            runs.insert(i + 1, (st, il, True))
+                        else:
+                            runs[i:i + 1] = [(o, off, True), (st, il, True),
+                                             (o + off, l - off, True)]
+                        break
+                    before += lv
+            next_order += il
+        peak = max(peak, len(runs))
+    return peak, len(runs)
 
 
 def expand_runs(res: RleResult, doc_index: int = 0) -> np.ndarray:

@@ -131,8 +131,9 @@ def load_doc(path: str):
 
 
 def save_flat_doc(flat, path: str) -> None:
-    """Checkpoint a device ``FlatDoc`` (downloads once)."""
-    n = int(flat.n)
+    """Checkpoint a device ``FlatDoc`` (downloads once). Accepts an
+    unbatched doc or a ``stack_docs`` batch (leading doc axis on every
+    column, including ``n``/``next_order``)."""
     np.savez(
         path,
         meta=_meta_to_array({"version": FORMAT_VERSION, "kind": "flat"}),
@@ -141,8 +142,8 @@ def save_flat_doc(flat, path: str) -> None:
         or_log=np.asarray(flat.or_log),
         rank_log=np.asarray(flat.rank_log),
         chars_log=np.asarray(flat.chars_log),
-        n=np.asarray(n),
-        next_order=np.asarray(int(flat.next_order)),
+        n=np.asarray(flat.n),
+        next_order=np.asarray(flat.next_order),
     )
 
 
@@ -161,6 +162,6 @@ def load_flat_doc(path: str):
         or_log=jnp.asarray(z["or_log"]),
         rank_log=jnp.asarray(z["rank_log"]),
         chars_log=jnp.asarray(z["chars_log"]),
-        n=jnp.asarray(int(z["n"]), I32),
-        next_order=jnp.asarray(int(z["next_order"]), U32),
+        n=jnp.asarray(z["n"], I32),
+        next_order=jnp.asarray(z["next_order"], U32),
     )

@@ -90,6 +90,8 @@ def memory_stats(doc, spans=None) -> dict:
         cols = {k: getattr(doc, k).nbytes
                 for k in ("order", "origin_left", "origin_right",
                           "deleted", "chars")}
+    elif hasattr(doc, "memory_bytes"):  # native engine: measured total
+        cols = {"native_engine": int(doc.memory_bytes())}
     else:
         cols = {k: int(np.prod(getattr(doc, k).shape)
                        * getattr(doc, k).dtype.itemsize)
