@@ -10,14 +10,15 @@ whole BASELINE.json table and writes it to ``BENCH_ALL.json``:
    native C++ engine), plus the TPU north-star row.
 2. ``random_edits`` workload, identical docs batched in the lane dim.
 3. ragged mixed corpus (rustcode + sveltecomponent) — divergent doc
-   GROUPS on the HBM engine's grid dimension.
+   GROUPS on the rle engine's grid dimension.
 4. N-peer concurrent-insert storm (tiebreak-heavy) — remote ops on the
    mixed blocked engine.
-5. streaming apply, delete-heavy, per-doc divergent streams on the flat
-   engine with periodic host<->device checkpoint resync.
+5. streaming apply, delete-heavy, per-doc DIVERGENT streams on the
+   per-lane rle engine, warm-started across chunks with checkpoint
+   resync.
 kevin: 5M single-char prepends (`benches/yjs.rs:51-62`) on the native
-   engine; the TPU row runs a reduced, honestly-labeled prefix (the
-   global-rebalance design degrades on the pure-prepend worst case).
+   engine; the TPU row runs 1M prepends on the HBM-state RLE engine
+   (leaf splits amortize the prepend worst case).
 
 Every row reports ops/sec/chip, ``mean_step_latency_us`` (wall / device
 steps), accounted + measured HBM bytes, slope-fit timing fields (see
@@ -239,7 +240,7 @@ def cfg_northstar(args):
         patches = patches[:args.patches]
     n_ops = len(patches)
     ins_total = sum(len(p.ins_content) for p in patches)
-    batch = args.batch
+    batch = args.batch or (256 if args.engine == "rle" else 128)
 
     base_ops, base_str = native_replay(patches)
     # Full-trace ground truth is shipped with the corpus; the O(n^2)
@@ -251,7 +252,8 @@ def cfg_northstar(args):
         merged = B.merge_patches(patches)
         lmax = max([len(p.ins_content) for p in merged] + [1])
         ops, _ = B.compile_local_patches(merged, lmax=lmax, dmax=None)
-        block_k = 256  # fixed for rle (--block-k applies to char engines)
+        # K=128 x 256 lanes is the measured optimum (PERF.md section 5).
+        block_k = 128
         capacity = args.capacity or 32768  # RUN rows, not chars
         capacity = ((capacity + block_k - 1) // block_k) * block_k
         log(f"[northstar] {args.trace}[:{n_ops}] -> {ops.num_steps} merged "
@@ -348,7 +350,9 @@ def cfg_2(args):
     from text_crdt_rust_tpu.ops import rle as R
 
     steps = 2000 if args.smoke else 20000
-    batch = args.batch
+    # Random edits need ~60k run rows; at >128 lanes the two VMEM planes
+    # blow the 110MB budget, so this config pins 128.
+    batch = min(args.batch, 128) if args.batch else 128
     patches, content = random_patches(random.Random(42), steps)
     base_ops, base_str = native_replay(patches)
     assert base_str == content
@@ -392,11 +396,12 @@ def cfg_3(args):
         base_total += ops_s
     base_avg = base_total / len(all_patches)
 
+    batch3 = args.batch or 128
     run = R.make_replayer_rle(opses, capacity=capacity,
-                              batch=args.batch, block_k=256,
+                              batch=batch3, block_k=256,
                               chunk=128 if args.smoke else 1024,
                               interpret=args.interpret)
-    hbm = 2 * len(opses) * capacity * args.batch * 4
+    hbm = 2 * len(opses) * capacity * batch3 * 4
     results, wall, dist = time_run(run, args.reps)
     ok = True
     for ops, res, want in zip(opses, results, wants):
@@ -405,7 +410,7 @@ def cfg_3(args):
     n_ops = sum(len(p) for p in all_patches)
     steps = sum(o.num_steps for o in opses)
     return make_row("config3_ragged_mixed_corpus", "rle-groups", n_ops,
-                    args.batch, wall, steps, hbm, base_avg, ok,
+                    batch3, wall, steps, hbm, base_avg, ok,
                     groups=list(names), **dist)
 
 
@@ -427,38 +432,39 @@ def cfg_4(args):
     total_chars = n_peers * rounds * run_len
     capacity = 2 << int(np.ceil(np.log2(max(total_chars, 256))))
     block_k = min(256, capacity // 2)
-    run = BM.make_replayer_mixed(ops, capacity=capacity, batch=args.batch,
+    batch4 = min(args.batch, 128) if args.batch else 128
+    run = BM.make_replayer_mixed(ops, capacity=capacity, batch=batch4,
                                  block_k=block_k,
                                  chunk=128 if args.smoke else 1024,
                                  interpret=args.interpret)
-    hbm = 2 * capacity * args.batch * 4
+    hbm = 2 * capacity * batch4 * 4
     res, wall, dist = time_run(run, args.reps)
     got = SA.to_string(BL.blocked_to_flat(ops, res))
     return make_row("config4_concurrent_insert_storm", "blocked-mixed",
-                    total_chars, args.batch, wall, ops.num_steps, hbm,
+                    total_chars, batch4, wall, ops.num_steps, hbm,
                     base_ops, got == want,
                     peers=n_peers, rounds=rounds, **dist)
 
 
 def cfg_5(args):
     """Config 5: streaming apply over per-doc DIVERGENT streams,
-    delete-heavy, with periodic host<->device checkpoint resync."""
-    from text_crdt_rust_tpu.utils.checkpoint import (
-        load_flat_doc,
-        save_flat_doc,
-    )
+    delete-heavy, with periodic host<->device checkpoint resync.
+
+    Engine: ``ops.rle_lanes`` — B distinct documents advance one op each
+    per kernel step (per-lane run state, warm-started across chunks),
+    replacing r2's flat-vmap fallback (~20 XLA dispatches per step).
+    """
+    from text_crdt_rust_tpu.ops import rle_lanes as RL
 
     n_docs = 16 if args.smoke else 2048
     chunks = 3 if args.smoke else 5
     steps_per_chunk = 30 if args.smoke else 100
-    lmax = 8
     rngs = [random.Random(1000 + d) for d in range(n_docs)]
     contents = [""] * n_docs
 
     def next_chunk():
         streams = []
         for d in range(n_docs):
-            # Delete-heavy: ins_prob 0.45 once the doc has content.
             patches, content = _continue_patches(
                 rngs[d], contents[d], steps_per_chunk, ins_prob=0.45)
             contents[d] = content
@@ -466,48 +472,72 @@ def cfg_5(args):
         return streams
 
     all_chunks = [next_chunk() for _ in range(chunks)]
-    cap = 2048 if args.smoke else 8192
-    total_ins = max(
-        sum(len(p.ins_content) for ch in all_chunks for p in ch[d])
-        for d in range(n_docs))
-    assert total_ins < cap // 2, (total_ins, cap)
 
-    # Baseline: one doc's whole stream on the native engine.
+    # Capacity from the engine's row invariant: every op splices at most
+    # 2 new rows (insert splice / delete boundary splits), so
+    # 1 + 2*ops_per_doc rows can never overflow — no sampling, no sim.
+    ops_per_doc = chunks * steps_per_chunk
+    capacity = max(((1 + 2 * ops_per_doc + 127) // 128) * 128, 256)
+
     flat0 = [p for ch in all_chunks for p in ch[0]]
     base_ops, base_str = native_replay(flat0)
     assert base_str == contents[0]
 
-    docs = SA.stack_docs(SA.make_flat_doc(cap, 2 * cap), n_docs)
+    lmax = max((len(p.ins_content) for ch in all_chunks for ps in ch
+                for p in ps), default=1) or 1
+    ckpt = os.path.join(tempfile.mkdtemp(prefix="tcr_bench_"), "resync.npz")
+    next_orders = [0] * n_docs
+    state = None
     wall = 0.0
     n_ops = 0
     steps = 0
-    next_orders = [0] * n_docs
-    ckpt = os.path.join(tempfile.mkdtemp(prefix="tcr_bench_"), "resync.npz")
-    for ci, streams in enumerate(all_chunks):
+    stacked_all = []
+    for streams in all_chunks:
         opses = []
         for d, patches in enumerate(streams):
             ops, next_orders[d] = B.compile_local_patches(
-                patches, lmax=lmax, start_order=next_orders[d])
+                patches, lmax=lmax, dmax=None,
+                start_order=next_orders[d])
             opses.append(ops)
             n_ops += len(patches)
-        batched = B.stack_ops(opses)
-        steps += batched.num_steps
+        stacked = B.stack_ops(opses)
+        stacked_all.append(stacked)
+        steps += stacked.num_steps
+        run = RL.make_replayer_lanes(stacked, capacity=capacity,
+                                     chunk=128, init=state,
+                                     interpret=args.interpret)
         t0 = time.perf_counter()
-        docs = F.apply_ops_batch(docs, batched)
-        jax.block_until_ready(docs.signed)
+        res = run()
+        np.asarray(res.err)  # hard sync (tunnel; see time_run)
         wall += time.perf_counter() - t0
-        # Periodic resync: checkpoint to host, restore, re-upload.
+        res.check()
+        # Periodic resync: state -> host checkpoint -> restore -> device.
         t0 = time.perf_counter()
-        save_flat_doc(docs, ckpt)
-        docs = load_flat_doc(ckpt)
+        o, l, r = (np.asarray(x) for x in res.state())
+        np.savez(ckpt, ordp=o, lenp=l, rows=r)
+        z = np.load(ckpt)
+        state = (z["ordp"], z["lenp"], z["rows"])
         wall += time.perf_counter() - t0
-    ok = all(
-        SA.to_string(jax.tree.map(lambda x: x[d], docs)) == contents[d]
-        for d in range(0, n_docs, max(1, n_docs // 8)))
-    hbm = sum(np.asarray(x).nbytes for x in jax.tree.leaves(docs))
-    return make_row("config5_streaming_divergent_resync", "flat-vmap",
+
+    ok = True
+    for d in range(0, n_docs, max(1, n_docs // 8)):
+        flat = RL.expand_lane(res, d)
+        chars = {}
+        for stacked in stacked_all:
+            ilens = np.asarray(stacked.ins_len)[:, d]
+            starts = np.asarray(stacked.ins_order_start)[:, d]
+            cps = np.asarray(stacked.chars)[:, d]
+            for s in np.nonzero(ilens)[0]:
+                il = int(ilens[s])
+                st = int(starts[s])
+                for j in range(il):
+                    chars[st + j] = chr(int(cps[s, j]))
+        got = "".join(chars[int(o) - 1] for o in flat if o > 0)
+        ok = ok and (got == contents[d])
+    hbm = 2 * capacity * n_docs * 4 + 2 * steps * n_docs * 4
+    return make_row("config5_streaming_divergent_resync", "rle-lanes",
                     n_ops, 1, wall, steps, hbm, base_ops, ok,
-                    docs=n_docs, chunks=chunks)
+                    docs=n_docs, chunks=chunks, capacity=capacity)
 
 
 def _continue_patches(rng, content, steps, ins_prob):
@@ -560,8 +590,9 @@ def cfg_kevin(args):
     # blocks half full, so size ~2.1x rows.
     block_k = 64 if args.smoke else 512
     capacity = ((int(n_tpu * 2.1) + block_k - 1) // block_k) * block_k
+    batchk = args.batch or 128
     run = RH.make_replayer_rle_hbm(ops, capacity=capacity,
-                                   batch=args.batch, block_k=block_k,
+                                   batch=batchk, block_k=block_k,
                                    chunk=128 if args.smoke else 1024,
                                    interpret=args.interpret)
     res, wall, dist = time_run(run, 1)
@@ -570,9 +601,9 @@ def cfg_kevin(args):
     # Prepends reverse insertion order: orders must read N-1..0.
     order_ok = got_len == n_tpu and bool(
         (flat == np.arange(n_tpu, 0, -1, dtype=np.int32)).all())
-    tpu_row = make_row(f"kevin_tpu_{n_tpu}", "rle-hbm", n_tpu, args.batch,
+    tpu_row = make_row(f"kevin_tpu_{n_tpu}", "rle-hbm", n_tpu, batchk,
                        wall, ops.num_steps,
-                       2 * capacity * args.batch * 4,
+                       2 * capacity * batchk * 4,
                        n_native / best, got_len == n_tpu and order_ok,
                        **dist)
     return [cpu_row, tpu_row]
@@ -589,7 +620,9 @@ def main() -> None:
     ap.add_argument("--trace", default="automerge-paper")
     ap.add_argument("--patches", type=int, default=0,
                     help="northstar trace prefix (0 = FULL trace)")
-    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--batch", type=int, default=0,
+                    help="identical-doc lanes (0 = per-config default: "
+                         "northstar 256, others 128)")
     ap.add_argument("--lmax", type=int, default=16)
     ap.add_argument("--engine", choices=("rle", "blocked", "hbm"),
                     default="rle")

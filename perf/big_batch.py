@@ -1,0 +1,32 @@
+import sys, os; sys.path.insert(0, "/root/repo")
+import time
+import numpy as np
+from text_crdt_rust_tpu.ops import batch as B
+from text_crdt_rust_tpu.ops import rle as R
+from text_crdt_rust_tpu.utils.testdata import load_testing_data, trace_path, flatten_patches
+
+data = load_testing_data(trace_path("automerge-paper"))
+patches = flatten_patches(data)
+merged = B.merge_patches(patches)
+lmax = max(len(p.ins_content) for p in merged if p.ins_content)
+ops, _ = B.compile_local_patches(merged, lmax=lmax, dmax=None)
+
+for batch, cap, bk in ((512, 20480, 128), (384, 24576, 128)):
+    try:
+        run = R.make_replayer_rle(ops, capacity=cap, batch=batch,
+                                  block_k=bk, chunk=1024)
+        t0 = time.perf_counter()
+        res = run(); np.asarray(res.err); res.check()
+        print(f"B={batch} compile+first {time.perf_counter()-t0:.1f}s", flush=True)
+        t0 = time.perf_counter()
+        for _ in range(6): res = run()
+        np.asarray(res.err)
+        t8 = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(2): res = run()
+        np.asarray(res.err)
+        wall = (t8 - (time.perf_counter() - t0)) / 4
+        v = 259778 * batch / wall
+        print(f"B={batch} cap={cap} K={bk}: {wall*1e3:.1f}ms {v/2.09e6:.0f}x", flush=True)
+    except Exception as e:
+        print(f"B={batch} cap={cap} K={bk}: FAIL {str(e)[:90]}", flush=True)

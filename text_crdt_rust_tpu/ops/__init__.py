@@ -1,25 +1,32 @@
 """JAX/XLA device kernels — the TPU-native document engines.
 
-Two engines share one semantic model (the flattened YjsSpan item layout,
-see ``span_arrays``):
+All engines share one semantic model (the flattened YjsSpan item layout,
+see ``span_arrays``) and cross-check bit-identically in ``tests/``:
 
-- ``flat``    — correctness-first engine: per-item arrays in document order,
-                every op is O(capacity) fully-vectorized work. Supports the
-                complete op surface (local edits, remote inserts with the
-                YATA integrate scan + name-rank tiebreak, remote delete
-                tombstoning — excess-delete *counts* stay in the host-side
-                double_deletes log). The device twin of
-                ``models.oracle.ListCRDT``.
-- ``blocked`` — throughput engine for the north-star trace-replay path:
-                the document is a fixed grid of blocks; each op touches one
-                block plus an O(num_blocks) index, with periodic all-doc
-                rebalance passes replacing the reference B-tree's node splits
-                (`range_tree/mutations.rs:623-808`). Variants:
-                ``blocked_hbm`` keeps the block grid in HBM behind a DMA'd
-                VMEM window (full-trace documents), and ``blocked_mixed``
-                adds the remote-op hot path in-kernel (YATA integrate +
-                order-range deletes over an order->block index).
+- ``flat``      — correctness-first engine: per-item arrays in document
+                  order, every op O(capacity) fully-vectorized. Complete
+                  op surface (local edits, remote inserts with the YATA
+                  integrate scan + name-rank tiebreak, remote delete
+                  tombstoning). The device twin of ``models.oracle``.
+- ``rle``       — the north-star engine (round 3): state is RLE RUNS
+                  (``(start_order, signed_len)`` rows — `span.rs:6-119`'s
+                  compression on device), blocked with a logical block
+                  order and leaf SPLITS instead of global rebalances
+                  (`mutations.rs:623-808`). Consumes the RLE-merged op
+                  stream (``batch.merge_patches``). VMEM-resident.
+- ``rle_hbm``   — same run algebra with HBM state planes behind a
+                  one-block VMEM window: millions of run rows (the kevin
+                  prepend worst case, >VMEM documents).
+- ``rle_lanes`` — per-lane DIVERGENT documents: B distinct streams, one
+                  op per lane per step, warm-startable across compiled
+                  chunks (the streaming config-5 engine).
+- ``blocked`` / ``blocked_hbm`` — the round-2 per-character block
+                  engines (kept as references and for the unmerged-stream
+                  path); ``blocked_mixed`` adds the remote-op hot path
+                  in-kernel (concurrent-insert storms, config 4).
 
-``batch`` compiles editing traces into fixed-shape op tensors (the host-side
-analog of the reference's bench replay loop, `benches/yjs.rs:32-49`).
+``batch`` compiles editing traces into fixed-shape op tensors (the
+host-side analog of the reference's bench replay loop,
+`benches/yjs.rs:32-49`), RLE-merges patch streams, and owns the agent
+name-rank table incl. cross-epoch onboarding (``rank_remap``).
 """

@@ -88,8 +88,12 @@ class AgentTable:
 
     The device tiebreak compares ranks; ranks are the index of each name in
     the sorted name list, so rank order == name order (`doc.rs:206-209`).
-    All agents in a compiled stream must be registered up front — adding a
-    name later would reshuffle ranks under compiled steps.
+    Within ONE compiled stream the table must not change (the steps bake
+    ranks in). ACROSS compiled epochs peers may join freely: agent IDS are
+    append-only (``OrderAssigner`` state stays valid), and persisted rank
+    logs are re-based through ``rank_remap`` at the epoch boundary — the
+    mid-stream onboarding the reference punts on (`doc.rs:66-89` creates
+    agents on the fly but has no compiled state to re-base).
     """
 
     def __init__(self, names: Iterable[str] = ()):
@@ -121,6 +125,24 @@ class AgentTable:
 
     def rank_of(self, name: str) -> int:
         return int(self.rank_of_agent()[self.id_of(name)])
+
+
+def rank_remap(old_names: Sequence[str], table: AgentTable) -> np.ndarray:
+    """old-epoch rank -> new-epoch rank (u32[len(old_names)]).
+
+    When a peer joins between compiled epochs, the sorted-name ranks of
+    existing agents shift; device state that PERSISTED ranks (the by-order
+    ``rank_log`` a ``FlatDoc`` carries for the Yjs tiebreak) must be
+    re-based before applying steps compiled against the new table. Apply
+    with ``span_arrays.remap_rank_log``.
+    """
+    for n in old_names:
+        assert n in table._ids, f"agent {n!r} missing from the new table"
+    old_sorted = sorted(old_names)
+    out = np.zeros(len(old_names), dtype=np.uint32)
+    for old_rank, name in enumerate(old_sorted):
+        out[old_rank] = table.rank_of(name)
+    return out
 
 
 class OrderAssigner:

@@ -1,0 +1,423 @@
+"""Per-lane divergent RLE replay: B distinct documents, B distinct ops
+per kernel step.
+
+The blocked engines batch IDENTICAL docs in the lane dim (`_lane_scalar`
+collapses lanes into one control stream), so divergent small docs — the
+config-5 streaming shape — fell back to ``ops.flat``'s one-XLA-dispatch-
+per-step scan (r2 verdict weak #4). This engine removes the identical-
+lane assumption instead of the batching:
+
+- every document is ONE un-blocked run column (``CAP`` run rows packed
+  at the front) — config-5 docs are hundreds of runs, so the in-block
+  position scan covers the whole doc and the block machinery (descent,
+  splits, windows) disappears;
+- every op scalar of the blocked engines (``i_r``, ``off``, splice
+  shift, …) becomes a ``[1, B]`` lane VECTOR; the splice shift is ≤2
+  rows regardless of text length (the RLE insert property), so per-lane
+  dynamic shifts are two static ``pltpu.roll``s blended by per-lane
+  masks — the trick that makes divergence free;
+- a delete needs NO walk: the whole doc is in view, so one
+  flip+boundary-split pass retires any span (`mutations.rs:520-570`);
+- state planes are kernel INPUTS as well as outputs — chunk N+1 resumes
+  from chunk N's downloaded (or never-downloaded) state, the warm start
+  the blocked engines lack (r2 verdict weak #4/#5: "blocked engines only
+  cold-start").
+
+Per step the kernel applies B independent ops (one per lane), so wall
+per op is ~1/B of a blocked-engine step on the same shapes. Local ops
+only (KIND_LOCAL); remote streams go to ``ops.blocked_mixed``/``flat``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..common import ROOT_ORDER
+from .batch import KIND_LOCAL, OpTensors, prefill_logs
+from .blocked import _require
+from .span_arrays import FlatDoc, I32, U32, make_flat_doc
+
+
+def _vcumsum(x) -> jax.Array:
+    """Inclusive cumsum along rows (axis 0) via log2 roll-adds."""
+    n = x.shape[0]
+    row = lax.broadcasted_iota(jnp.int32, x.shape, 0)
+    out = x
+    shift = 1
+    while shift < n:
+        out = out + jnp.where(row >= shift, pltpu.roll(out, shift, axis=0), 0)
+        shift *= 2
+    return out
+
+
+def _vrow(arr, r):
+    """Per-lane row extraction: ``arr[r[0, b], b]`` as a [1, B] vector."""
+    idx = lax.broadcasted_iota(jnp.int32, arr.shape, 0)
+    return jnp.sum(jnp.where(idx == r, arr, 0), axis=0, keepdims=True)
+
+
+def _vshift(x, amt):
+    """Rows shifted down by per-lane ``amt`` in {0, 1, 2} ([1, B])."""
+    r1 = pltpu.roll(x, 1, axis=0)
+    r2 = pltpu.roll(x, 2, axis=0)
+    return jnp.where(amt == 0, x, jnp.where(amt == 1, r1, r2))
+
+
+def _rle_lanes_kernel(
+    pos_ref, dlen_ref, ilen_ref, start_ref,     # [CHUNK,B] VMEM op columns
+    ord0_ref, len0_ref, rows0_ref,              # warm-start state inputs
+    ol_ref, or_ref,                             # [CHUNK,B] outputs
+    ordp, lenp, rowsv, err_ref,                 # state outputs (working)
+    *, CAP: int, CHUNK: int,
+):
+    B = ordp.shape[1]
+    i = pl.program_id(0)
+    last = pl.num_programs(0) - 1
+    idx = lax.broadcasted_iota(jnp.int32, (CAP, B), 0)
+    root_u = jnp.uint32(ROOT_ORDER)
+
+    ol_ref[:] = jnp.zeros_like(ol_ref)
+    or_ref[:] = jnp.zeros_like(or_ref)
+
+    @pl.when(i == 0)
+    def _init():
+        ordp[:] = ord0_ref[:]
+        lenp[:] = len0_ref[:]
+        rowsv[:] = rows0_ref[:]
+        err_ref[:] = jnp.zeros_like(err_ref)
+
+    def do_delete(p, d):
+        """Whole-doc single-pass delete, per-lane (active where d > 0)."""
+        active = d > 0
+        rows = rowsv[:]
+
+        @pl.when(jnp.any(active & (rows + 2 > CAP)))
+        def _cap():
+            err_ref[0:1, :] = jnp.where(active & (rows + 2 > CAP), 1,
+                                        err_ref[0:1, :])
+
+        bo = ordp[:]
+        bl = lenp[:]
+        lv = jnp.where(bo > 0, bl, 0)
+        cum = _vcumsum(lv)
+        before = cum - lv
+        rem = jnp.where(active, d, 0)
+        cs = jnp.clip(p - before, 0, lv)
+        ce = jnp.clip(p + rem - before, 0, lv)
+        cov = ce - cs
+        tot = jnp.sum(cov, axis=0, keepdims=True)
+
+        @pl.when(jnp.any(active & (tot < rem)))
+        def _bad():
+            err_ref[1:2, :] = jnp.where(active & (tot < rem), 1,
+                                        err_ref[1:2, :])
+
+        full = (cov > 0) & (cov == bl)
+        part = (cov > 0) & jnp.logical_not(full)
+        npart = jnp.sum(part.astype(jnp.int32), axis=0, keepdims=True)
+        i1 = jnp.min(jnp.where(part, idx, CAP), axis=0, keepdims=True)
+        i2 = jnp.max(jnp.where(part, idx, -1), axis=0, keepdims=True)
+
+        bo = jnp.where(full, -bo, bo)
+
+        def apply_partial(act, i_p, bo, bl):
+            o = _vrow(bo, i_p)
+            ln = _vrow(bl, i_p)
+            cs_i = _vrow(cs, i_p)
+            ce_i = _vrow(ce, i_p)
+            cov_i = ce_i - cs_i
+            has_head = (cs_i > 0) & act
+            has_tail = (ce_i < ln) & act
+            amt = has_head.astype(jnp.int32) + has_tail.astype(jnp.int32)
+            so = _vshift(bo, amt)
+            sl = _vshift(bl, amt)
+            no = jnp.where(idx <= i_p, bo, so)
+            nl = jnp.where(idx <= i_p, bl, sl)
+            p0o = jnp.where(has_head, o, -(o + cs_i))
+            p0l = jnp.where(has_head, cs_i, cov_i)
+            p1o = jnp.where(has_head, -(o + cs_i), o + ce_i)
+            p1l = jnp.where(has_head, cov_i, ln - ce_i)
+            w0 = act & (idx == i_p)
+            no = jnp.where(w0, p0o, no)
+            nl = jnp.where(w0, p0l, nl)
+            w1 = act & (idx == i_p + 1) & (amt >= 1)
+            no = jnp.where(w1, p1o, no)
+            nl = jnp.where(w1, p1l, nl)
+            w2 = act & (idx == i_p + 2) & (amt == 2)
+            no = jnp.where(w2, o + ce_i, no)
+            nl = jnp.where(w2, ln - ce_i, nl)
+            return no, nl, amt
+
+        bo, bl, a2 = apply_partial(active & (npart >= 1), i2, bo, bl)
+        bo, bl, a1 = apply_partial(active & (npart == 2), i1, bo, bl)
+        ordp[:] = bo
+        lenp[:] = bl
+        rowsv[:] = rowsv[:] + jnp.where(active, a1 + a2, 0)
+
+    def do_insert(k, p, il, st):
+        """Per-lane insert splice (active where il > 0)."""
+        active = il > 0
+        rows = rowsv[:]
+
+        @pl.when(jnp.any(active & (rows + 2 > CAP)))
+        def _cap():
+            err_ref[0:1, :] = jnp.where(active & (rows + 2 > CAP), 1,
+                                        err_ref[0:1, :])
+
+        bo = ordp[:]
+        bl = lenp[:]
+        lv = jnp.where(bo > 0, bl, 0)
+        cum = _vcumsum(lv)
+        local = jnp.where(active, p, 0)
+        i_r = jnp.sum(((cum < local) & (idx < rows)).astype(jnp.int32),
+                      axis=0, keepdims=True)
+        o_r = _vrow(bo, i_r)
+        l_r = _vrow(bl, i_r)
+        off = local - (_vrow(cum, i_r) - _vrow(lv, i_r))
+
+        left = jnp.where(p == 0, root_u,
+                         ((o_r - 1) + (off - 1)).astype(jnp.uint32))
+        mrg = active & (p > 0) & (off == l_r) & ((st + 1) == (o_r + l_r))
+        is_split = active & (p > 0) & (off < l_r)
+
+        nxt_in_blk = _vrow(bo, i_r + 1)
+        first_o = _vrow(bo, 0)
+        succ_p0 = jnp.where(rows > 0, first_o, 0)
+        succ_after = jnp.where(i_r + 1 < rows, nxt_in_blk, 0)
+        succ = jnp.where(p == 0, succ_p0,
+                         jnp.where(is_split, o_r + off, succ_after))
+        right = jnp.where(succ == 0, root_u,
+                          (jnp.abs(succ) - 1).astype(jnp.uint32))
+
+        ins_at = jnp.where(p == 0, 0, i_r + 1)
+        amt = jnp.where(jnp.logical_not(active) | mrg, 0,
+                        jnp.where(is_split, 2, 1))
+        so = _vshift(bo, amt)
+        sl = _vshift(bl, amt)
+        no = jnp.where(idx < ins_at, bo, so)
+        nl = jnp.where(idx < ins_at, bl, sl)
+        nl = jnp.where(is_split & (idx == i_r), off, nl)
+        new_run = active & jnp.logical_not(mrg) & (idx == ins_at)
+        no = jnp.where(new_run, st + 1, no)
+        nl = jnp.where(new_run, il, nl)
+        tail = is_split & (idx == ins_at + 1)
+        no = jnp.where(tail, o_r + off, no)
+        nl = jnp.where(tail, l_r - off, nl)
+        nl = jnp.where(mrg & (idx == i_r), l_r + il, nl)
+        # Lanes with amt == 0 and no merge keep bo/bl exactly (masks are
+        # all False there and _vshift(amt=0) is the identity).
+        ordp[:] = no
+        lenp[:] = nl
+        rowsv[:] = rows + amt
+
+        ol_ref[pl.ds(k, 1), :] = jnp.where(active, left, 0)
+        or_ref[pl.ds(k, 1), :] = jnp.where(active, right, 0)
+
+    def op_body(k, _):
+        p = pos_ref[pl.ds(k, 1), :]
+        d = dlen_ref[pl.ds(k, 1), :]
+        il = ilen_ref[pl.ds(k, 1), :]
+        st = start_ref[pl.ds(k, 1), :]
+
+        @pl.when(jnp.any(d > 0))
+        def _():
+            do_delete(p, d)
+
+        @pl.when(jnp.any(il > 0))
+        def _():
+            do_insert(k, p, il, st)
+
+        return 0
+
+    lax.fori_loop(0, CHUNK, op_body, 0)
+    del last
+
+
+@dataclasses.dataclass
+class LanesResult:
+    """Device outputs: per-lane divergent documents."""
+
+    ordp: jax.Array     # i32[CAP, B]
+    lenp: jax.Array     # i32[CAP, B]
+    rows: jax.Array     # i32[1, B] occupied run rows per lane
+    ol: jax.Array       # u32[S, B]
+    orr: jax.Array      # u32[S, B]
+    err: jax.Array      # i32[8, B]  0: capacity; 1: bad delete (per lane)
+    batch: int
+
+    def check(self) -> None:
+        err = np.asarray(self.err)
+        if err[0].max() != 0:
+            raise RuntimeError(
+                f"rle_lanes capacity exhausted on lanes "
+                f"{np.nonzero(err[0])[0][:8].tolist()}; raise capacity")
+        if err[1].max() != 0:
+            raise RuntimeError(
+                f"delete ran past the end of the document on lanes "
+                f"{np.nonzero(err[1])[0][:8].tolist()}")
+
+    def state(self):
+        """(ordp, lenp, rows) — feed as ``init`` to the next chunk's
+        replayer (stays on device; the warm-start chain)."""
+        return self.ordp, self.lenp, self.rows
+
+
+@functools.lru_cache(maxsize=32)
+def _build_call(s_pad: int, B: int, capacity: int, chunk: int,
+                interpret: bool):
+    """Shape-keyed cache: streaming chunks share one compiled kernel
+    (a per-chunk pallas_call would re-trace and re-compile ~5-30s each —
+    the whole point of warm starts is that chunk N+1 is cheap)."""
+    col = lambda: pl.BlockSpec((chunk, B), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)
+    whole = lambda shape: pl.BlockSpec(
+        shape, lambda i: tuple(0 for _ in shape), memory_space=pltpu.VMEM)
+
+    call = pl.pallas_call(
+        partial(_rle_lanes_kernel, CAP=capacity, CHUNK=chunk),
+        grid=(s_pad // chunk,),
+        in_specs=[col(), col(), col(), col(),
+                  whole((capacity, B)), whole((capacity, B)),
+                  whole((1, B))],
+        out_specs=[
+            col(), col(),
+            whole((capacity, B)), whole((capacity, B)),
+            whole((1, B)), whole((8, B)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((s_pad, B), jnp.uint32),
+            jax.ShapeDtypeStruct((s_pad, B), jnp.uint32),
+            jax.ShapeDtypeStruct((capacity, B), jnp.int32),
+            jax.ShapeDtypeStruct((capacity, B), jnp.int32),
+            jax.ShapeDtypeStruct((1, B), jnp.int32),
+            jax.ShapeDtypeStruct((8, B), jnp.int32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=110 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )
+    return jax.jit(lambda *a: call(*a))
+
+
+def make_replayer_lanes(
+    ops: OpTensors,
+    capacity: int,
+    chunk: int = 128,
+    init=None,
+    interpret: bool = False,
+):
+    """Build a jitted per-lane replayer for a BATCHED op stream
+    (``stack_ops`` output: every column [S, B]).
+
+    ``capacity`` counts RUN rows per document. ``init`` is an optional
+    ``(ordp, lenp, rows)`` triple from a previous ``LanesResult.state()``
+    — the warm start; None = empty documents.
+    """
+    kinds = np.asarray(ops.kind)
+    _require(kinds.ndim == 2, "rle_lanes takes stacked per-doc streams "
+             "([S, B] columns; see batch.stack_ops)")
+    _require(bool((kinds == KIND_LOCAL).all()),
+             "rle_lanes replays local streams; remote ops -> "
+             "ops.blocked_mixed / ops.flat")
+    S, B = kinds.shape
+    _require(capacity >= 8, "capacity must hold a few runs")
+    s_pad = max(((S + chunk - 1) // chunk) * chunk, chunk)
+
+    def staged_col(get):
+        a = np.asarray(get(ops), dtype=np.int32)
+        return jnp.asarray(np.pad(a, ((0, s_pad - S), (0, 0))))
+
+    staged = (staged_col(lambda o: o.pos),
+              staged_col(lambda o: o.del_len),
+              staged_col(lambda o: o.ins_len),
+              staged_col(lambda o: o.ins_order_start))
+
+    if init is None:
+        init = (jnp.zeros((capacity, B), jnp.int32),
+                jnp.zeros((capacity, B), jnp.int32),
+                jnp.zeros((1, B), jnp.int32))
+    else:
+        o0, l0, r0 = init
+        _require(tuple(o0.shape) == (capacity, B),
+                 f"init state shape {o0.shape} != ({capacity}, {B})")
+        init = (jnp.asarray(o0, jnp.int32), jnp.asarray(l0, jnp.int32),
+                jnp.asarray(r0, jnp.int32).reshape(1, B))
+
+    jitted = _build_call(s_pad, B, capacity, chunk, interpret)
+
+    def run(state=None) -> LanesResult:
+        ini = init if state is None else (
+            state[0], state[1], state[2].reshape(1, B))
+        ol, orr, ordp, lenp, rows, err = jitted(*staged, *ini)
+        return LanesResult(ordp=ordp, lenp=lenp, rows=rows,
+                           ol=ol[:S], orr=orr[:S], err=err, batch=B)
+
+    return run
+
+
+def replay_lanes(ops: OpTensors, capacity: int, **kw) -> LanesResult:
+    """One-shot convenience wrapper over ``make_replayer_lanes``."""
+    return make_replayer_lanes(ops, capacity, **kw)()
+
+
+def expand_lane(res: LanesResult, doc_index: int) -> np.ndarray:
+    """One lane's run rows -> per-char ±(order+1) column in doc order."""
+    res.check()
+    r = int(np.asarray(res.rows)[0, doc_index])
+    o = np.asarray(res.ordp)[:r, doc_index].astype(np.int64)
+    ln = np.asarray(res.lenp)[:r, doc_index].astype(np.int64)
+    if r == 0:
+        return np.zeros(0, np.int32)
+    assert (ln > 0).all(), "occupied run with non-positive length"
+    total = int(ln.sum())
+    base = np.repeat(np.abs(o), ln)
+    within = np.arange(total) - np.repeat(np.cumsum(ln) - ln, ln)
+    return (np.repeat(np.sign(o), ln) * (base + within)).astype(np.int32)
+
+
+def lanes_to_flat(
+    ops: OpTensors,
+    res: LanesResult,
+    doc_index: int,
+    capacity: int | None = None,
+    order_capacity: int | None = None,
+) -> FlatDoc:
+    """One lane -> a standard ``FlatDoc`` (prefill + per-op origins)."""
+    flat = expand_lane(res, doc_index)
+    n = len(flat)
+    if capacity is None:
+        capacity = max(2 << max(n - 1, 5).bit_length(), n)
+    per_doc = jax.tree.map(lambda a: np.asarray(a)[:, doc_index], ops)
+    doc = make_flat_doc(capacity, order_capacity)
+    doc = prefill_logs(doc, per_doc)
+    ol_log = np.array(doc.ol_log)
+    or_log = np.array(doc.or_log)
+    starts = np.asarray(per_doc.ins_order_start, dtype=np.int64)
+    ilens = np.asarray(per_doc.ins_len, dtype=np.int64)
+    ol_np = np.asarray(res.ol)[:, doc_index]
+    or_np = np.asarray(res.orr)[:, doc_index]
+    for st, il, left, right in zip(starts, ilens, ol_np, or_np):
+        if il > 0:
+            ol_log[st] = left
+            or_log[st: st + il] = right
+
+    signed_col = np.zeros(capacity, np.int32)
+    signed_col[:n] = flat
+    advance = int(np.asarray(per_doc.order_advance, dtype=np.int64).sum())
+    return dataclasses.replace(
+        doc,
+        signed=jnp.asarray(signed_col),
+        ol_log=jnp.asarray(ol_log),
+        or_log=jnp.asarray(or_log),
+        n=jnp.asarray(n, I32),
+        next_order=jnp.asarray(advance, U32),
+    )

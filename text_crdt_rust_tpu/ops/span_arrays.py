@@ -192,3 +192,15 @@ def upload_oracle(
         n=jnp.asarray(n, dtype=I32),
         next_order=jnp.asarray(next_order, dtype=U32),
     )
+
+
+def remap_rank_log(doc: FlatDoc, mapping) -> FlatDoc:
+    """Re-base the by-order author-rank log through an old->new rank
+    mapping (``batch.rank_remap``) at an agent-onboarding epoch boundary.
+    Ranks at or beyond ``len(mapping)`` (never written by the old epoch)
+    pass through unchanged."""
+    m = jnp.asarray(np.asarray(mapping, dtype=np.uint32))
+    old = doc.rank_log
+    safe = jnp.minimum(old, m.shape[0] - 1).astype(jnp.int32)
+    new = jnp.where(old < m.shape[0], m[safe], old)
+    return dataclasses.replace(doc, rank_log=new.astype(jnp.uint32))
