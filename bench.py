@@ -583,7 +583,7 @@ def cfg_kevin(args):
                        best, n_native, 0, n_native / best,
                        len(doc) == n_native)
 
-    n_tpu = 2048 if args.smoke else 1_000_000
+    n_tpu = 2048 if args.smoke else args.kevin_n
     patches = [TestPatch(0, 0, " ")] * n_tpu
     ops, _ = B.compile_local_patches(patches, lmax=1, dmax=None)
     # One run row per prepend (runs cannot merge backwards); splits leave
@@ -626,6 +626,10 @@ def main() -> None:
     ap.add_argument("--lmax", type=int, default=16)
     ap.add_argument("--engine", choices=("rle", "blocked", "hbm"),
                     default="rle")
+    ap.add_argument("--kevin-n", type=int, default=1_000_000,
+                    help="kevin TPU prepend count (5_000_000 = the full "
+                         "reference workload; pair with --batch 64 to fit "
+                         "HBM)")
     ap.add_argument("--capacity", type=int, default=0,
                     help="rle engine run-row capacity (0 = default 32768; "
                          "rounded up to a 256-row block multiple)")

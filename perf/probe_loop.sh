@@ -1,0 +1,10 @@
+#!/bin/bash
+cd /root/repo
+for i in $(seq 1 40); do
+  timeout 90 python -c "
+import jax, jax.numpy as jnp
+y = (jnp.ones((64,64))@jnp.ones((64,64))).sum()
+print('CHIP_OK', float(y))" 2>/dev/null | grep CHIP_OK && exit 0
+  sleep 60
+done
+exit 1

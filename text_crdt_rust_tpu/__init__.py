@@ -5,12 +5,16 @@ integration semantics over an automerge-style (agent, seq) data model.
 
 Layout (see each subpackage's __init__ for what is implemented):
 
-- ``models/``   document engines (Python oracle + sync layer; C++ native and
-                JAX/TPU batched engines join them as they land);
-- ``utils/``    RLE span algebra + flat containers (the host↔device wire
-                format), trace loader;
-- ``ops/``, ``parallel/``, ``native/``  device kernels, mesh sharding and
-                C++ sources respectively.
+- ``models/``    document engines: Python oracle, C++ native engine
+                 (ctypes), peer sync;
+- ``ops/``       device kernels: the RLE run engines (``rle`` /
+                 ``rle_hbm`` / ``rle_lanes``), per-char engines
+                 (``flat`` / ``blocked*``), the op compiler (``batch``);
+- ``parallel/``  mesh sharding (dp/sp) + the causal buffer;
+- ``utils/``     RLE span algebra, trace loader, metrics, checkpoint;
+- ``native/``    C++ sources + build;
+- ``examples/``  soak and stats CLIs;
+- ``config``     the dataclass config layer.
 """
 
 from .common import (
