@@ -1,0 +1,147 @@
+"""Sequence-parallel run lookups vs a host reference (8-dev CPU mesh).
+
+One document's RLE run rows sharded over sp=8; the two hot conversions
+(`README.md:20-26`) must return exactly what a single-host walk over the
+same runs returns, for every live rank and a sweep of orders — including
+runs that straddle shard boundaries and shards that are all tombstones.
+"""
+import random
+
+import numpy as np
+import pytest
+
+from text_crdt_rust_tpu.ops import batch as B
+from text_crdt_rust_tpu.parallel import make_mesh
+from text_crdt_rust_tpu.parallel.sp_runs import make_sp_ops, shard_runs
+from text_crdt_rust_tpu.utils.testdata import (
+    flatten_patches,
+    load_testing_data,
+    trace_path,
+)
+
+
+def runs_from_patches(patches):
+    """(ordp, lenp) planes via the kernel-exact host simulation."""
+    from text_crdt_rust_tpu.ops.rle import simulate_run_rows
+
+    # simulate_run_rows mirrors the kernel but returns counts; rebuild
+    # the run list with the same walk.
+    runs = []
+    next_order = 0
+    for p in B.merge_patches(patches):
+        if p.del_len:
+            rem, before, i = p.del_len, 0, 0
+            while rem > 0 and i < len(runs):
+                o, l, live = runs[i]
+                lv = l if live else 0
+                cs = min(max(p.pos - before, 0), lv)
+                ce = min(max(p.pos + rem - before, 0), lv)
+                cov = ce - cs
+                if cov > 0:
+                    parts = []
+                    if cs > 0:
+                        parts.append((o, cs, True))
+                    parts.append((o + cs, cov, False))
+                    if ce < l:
+                        parts.append((o + ce, l - ce, True))
+                    runs[i:i + 1] = parts
+                    i += len(parts)
+                    rem -= cov
+                else:
+                    i += 1
+                before += lv - cov
+            next_order += p.del_len
+        il = len(p.ins_content)
+        if il:
+            st = next_order
+            if p.pos == 0:
+                runs.insert(0, (st, il, True))
+            else:
+                before = 0
+                for i, (o, l, live) in enumerate(runs):
+                    lv = l if live else 0
+                    if before + lv >= p.pos:
+                        off = p.pos - before
+                        if off == l and live and st == o + l:
+                            runs[i] = (o, l + il, True)
+                        elif off == lv:
+                            runs.insert(i + 1, (st, il, True))
+                        else:
+                            runs[i:i + 1] = [(o, off, True),
+                                             (st, il, True),
+                                             (o + off, l - off, True)]
+                        break
+                    before += lv
+            next_order += il
+    ordp = np.asarray([(o + 1) if live else -(o + 1)
+                       for o, l, live in runs], np.int32)
+    lenp = np.asarray([l for o, l, live in runs], np.int32)
+    _ = simulate_run_rows  # imported to keep the mirror source adjacent
+    return ordp, lenp
+
+
+def host_lookups(ordp, lenp):
+    """Reference walks: per-char doc order and live positions."""
+    chars = []  # (order, live) per char in doc order
+    for o, l in zip(ordp, lenp):
+        start = abs(int(o)) - 1
+        live = o > 0
+        for j in range(int(l)):
+            chars.append((start + j, bool(live)))
+    live_chars = [c for c in chars if c[1]]
+    return chars, live_chars
+
+
+@pytest.fixture(scope="module")
+def sharded():
+    data = load_testing_data(trace_path("sveltecomponent"))
+    patches = flatten_patches(data)[:1200]
+    ordp, lenp = runs_from_patches(patches)
+    mesh = make_mesh(dp=1, sp=8)
+    o_dev, l_dev = shard_runs(ordp, lenp, mesh)
+    return ordp, lenp, make_sp_ops(mesh), o_dev, l_dev
+
+
+class TestSpRuns:
+    def test_live_prefix_total(self, sharded):
+        ordp, lenp, ops, o_dev, l_dev = sharded
+        _, total = ops.live_prefix(o_dev, l_dev)
+        want = int(np.where(ordp > 0, lenp, 0).sum())
+        assert int(total) == want
+
+    def test_position_of_live_rank_sweep(self, sharded):
+        ordp, lenp, ops, o_dev, l_dev = sharded
+        chars, live_chars = host_lookups(ordp, lenp)
+        n_live = len(live_chars)
+        # Host expectation: rank -> (global run row, offset) by walking
+        # run rows and counting live chars.
+        rng = random.Random(3)
+        ranks = sorted(rng.sample(range(1, n_live + 1), 40)) + [1, n_live]
+        for rank in ranks:
+            row, off = ops.position_of_live_rank(o_dev, l_dev, rank)
+            row, off = int(row), int(off)
+            # Decode via the padded planes the device saw.
+            o_pad = np.asarray(o_dev)
+            l_pad = np.asarray(l_dev)
+            assert o_pad[row] > 0, (rank, row)
+            assert 1 <= off <= l_pad[row]
+            # The char at that (row, off) is the rank'th live char.
+            lv = np.where(o_pad > 0, l_pad, 0)
+            live_before = int(lv[:row].sum()) + (off - 1)
+            assert live_before == rank - 1
+
+    def test_order_to_position_sweep(self, sharded):
+        ordp, lenp, ops, o_dev, l_dev = sharded
+        chars, _ = host_lookups(ordp, lenp)
+        pos_of = {}
+        live_seen = 0
+        for order, live in chars:
+            pos_of[order] = live_seen if live else -1
+            live_seen += live
+        rng = random.Random(5)
+        orders = rng.sample(sorted(pos_of), 40)
+        for order in orders:
+            got = int(ops.order_to_position(o_dev, l_dev, order))
+            assert got == pos_of[order], (order, got, pos_of[order])
+        # Unknown order -> -1.
+        assert int(ops.order_to_position(o_dev, l_dev, 10**8)) == -1

@@ -11,6 +11,11 @@ net-new, first-class parallel components the TPU build requires:
 - ``causal``  — the causal receive buffer for out-of-order remote txns (the
                 reference's "we either need to skip or buffer" gap,
                 `doc.rs:246-247`).
+- ``sp_runs`` — sequence-parallel RLE runs: ONE huge document's run rows
+                sharded over the ``sp`` axis, hot-path lookups as
+                shard-local scans + one ICI collective (``shard_map`` +
+                ``psum``) — the long-context carry-propagating scan of
+                SURVEY §5.
 """
 from .causal import CausalBuffer
 from .mesh import (
@@ -19,11 +24,14 @@ from .mesh import (
     shard_docs,
     shard_ops,
 )
+from .sp_runs import make_sp_ops, shard_runs
 
 __all__ = [
     "CausalBuffer",
     "make_mesh",
     "make_sharded_apply",
+    "make_sp_ops",
     "shard_docs",
     "shard_ops",
+    "shard_runs",
 ]
