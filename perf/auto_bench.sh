@@ -1,0 +1,16 @@
+#!/bin/bash
+# Probe until the chip answers, then run the full bench table + kevin 5M.
+cd /root/repo
+for i in $(seq 1 200); do
+  timeout 90 python -c "
+import jax, jax.numpy as jnp
+y = (jnp.ones((64,64))@jnp.ones((64,64))).sum()
+print('CHIP_OK', float(y))" 2>/dev/null | grep -q CHIP_OK && break
+  sleep 90
+  [ $i -eq 200 ] && exit 1
+done
+echo "chip recovered at $(date)" > perf/auto_bench.log
+python bench.py --config all --reps 8 --out BENCH_ALL.json >> perf/auto_bench.log 2>&1
+echo "BENCH_ALL done rc=$? at $(date)" >> perf/auto_bench.log
+python bench.py --config kevin --kevin-n 5000000 --batch 64 --reps 1 >> perf/kevin5m.log 2>&1
+echo "kevin5m done rc=$? at $(date)" >> perf/auto_bench.log

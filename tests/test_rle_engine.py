@@ -192,3 +192,28 @@ class TestExpandRuns:
         flat = R.expand_runs(res)
         # orders 0..3 in doc order; chars b,c (orders 1,2) tombstoned.
         assert list(flat) == [1, -2, -3, 4]
+
+
+class TestVsNativeEngine:
+    """Direct device<->C++ bit-equality (SURVEY §4: CPU<->TPU equality of
+    order arrays + tombstone signs per batch): the rle engine's canonical
+    spans must equal the native engine's on a real trace prefix."""
+
+    def test_trace_prefix_spans_equal_native(self):
+        from text_crdt_rust_tpu.models.native import NativeListCRDT
+
+        data = load_testing_data(trace_path("automerge-paper"))
+        patches = flatten_patches(data)[:600]
+        _, doc = run_rle(patches, capacity=512, block_k=16)
+
+        nd = NativeListCRDT()
+        agent = nd.get_or_create_agent_id("bench")
+        cps = np.frombuffer(
+            "".join(p.ins_content for p in patches).encode("utf-32-le"),
+            np.uint32)
+        nd.replay_trace(agent, [p.pos for p in patches],
+                        [p.del_len for p in patches],
+                        [len(p.ins_content) for p in patches], cps)
+        from text_crdt_rust_tpu.ops import span_arrays as SA2
+        assert SA2.doc_spans(doc) == nd.doc_spans()
+        assert SA2.to_string(doc) == nd.to_string()
