@@ -1,0 +1,34 @@
+import os, random, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, "/root/repo"); sys.path.insert(0, "/root/repo/tests")
+from text_crdt_rust_tpu.ops import batch as B, flat as F, rle as R
+from text_crdt_rust_tpu.ops import rle_hbm as RH, rle_lanes as RL
+from text_crdt_rust_tpu.ops import span_arrays as SA
+from test_device_flat import random_patches
+
+fails = 0
+for seed in range(100, 120):
+    rng = random.Random(seed)
+    patches, content = random_patches(rng, 60)
+    merged = B.merge_patches(patches)
+    lmax = max([len(p.ins_content) for p in merged] + [1])
+    ops, _ = B.compile_local_patches(merged, lmax=lmax, dmax=None)
+    ref = F.apply_ops(SA.make_flat_doc(512),
+                      B.compile_local_patches(patches, lmax=8, dmax=None)[0])
+    want = SA.to_string(ref)
+    assert want == content
+    r1 = R.replay_local_rle(ops, capacity=256, batch=8, block_k=8,
+                            chunk=64, interpret=True)
+    r2 = RH.replay_local_rle_hbm(ops, capacity=256, batch=8, block_k=8,
+                                 chunk=64, interpret=True)
+    stacked = B.stack_ops([ops] * 4)
+    r3 = RL.replay_lanes(stacked, capacity=256, chunk=16, interpret=True)
+    ok = (SA.to_string(R.rle_to_flat(ops, r1)) == want
+          and SA.to_string(R.rle_to_flat(ops, r2)) == want
+          and SA.to_string(RL.lanes_to_flat(stacked, r3, 2)) == want)
+    if not ok:
+        fails += 1
+        print(f"seed {seed}: DIVERGED", flush=True)
+print(f"fuzz: 20 seeds x 3 engines, {fails} failures", flush=True)
