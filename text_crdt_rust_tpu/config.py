@@ -13,14 +13,21 @@ import argparse
 import dataclasses
 from typing import Optional, Sequence
 
+# The one canonical engine-name tuple (advisor r3: bench.py and
+# EngineConfig had drifted apart). Every CLI choice list derives from
+# this; ops/__init__ exposes the same names.
+ENGINE_CHOICES = ("rle", "rle-hbm", "rle-lanes", "rle-mixed", "blocked",
+                  "blocked-mixed", "hbm", "flat")
+
 
 @dataclasses.dataclass
 class EngineConfig:
     """Device-engine knobs shared by the replay engines."""
 
-    engine: str = "rle"        # rle | blocked | hbm | flat
-    batch: int = 128           # docs in the lane dim (128 = one lane tile;
-    #                            larger crashes Mosaic today, PERF.md §1)
+    engine: str = "rle"        # one of ENGINE_CHOICES
+    batch: int = 128           # docs in the lane dim (256 is the measured
+    #                            northstar optimum; 512+ exceeds VMEM,
+    #                            PERF.md §5)
     block_k: int = 256         # rows per block (rle: RUN rows)
     chunk: int = 1024          # ops per grid step (TPU wants %1024)
     capacity: int = 0          # state rows; 0 = per-workload default
@@ -29,7 +36,7 @@ class EngineConfig:
 
     def add_args(self, ap: argparse.ArgumentParser) -> None:
         ap.add_argument("--engine", default=self.engine,
-                        choices=("rle", "blocked", "hbm", "flat"))
+                        choices=ENGINE_CHOICES)
         ap.add_argument("--batch", type=int, default=self.batch)
         ap.add_argument("--block-k", type=int, default=self.block_k)
         ap.add_argument("--chunk", type=int, default=self.chunk)
