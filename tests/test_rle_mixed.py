@@ -1,0 +1,178 @@
+"""Mixed-stream RLE run engine (remote ops on run rows) vs oracle.
+
+Interpreter-mode differential tests. Tiny blocks (block_k=8) force leaf
+SPLITS between remote lookups, exercising the stale-ordblk fallback and
+self-heal on the run representation; the scenarios mirror
+``test_blocked_mixed`` (the `doc.rs:242-348` apply paths) plus the
+config-4 concurrent-insert storm and cross-engine local equality with
+``ops.rle``.
+"""
+import random
+
+import pytest
+
+from text_crdt_rust_tpu.common import (
+    RemoteDel,
+    RemoteId,
+    RemoteIns,
+    RemoteTxn,
+)
+from text_crdt_rust_tpu.models.oracle import ListCRDT
+from text_crdt_rust_tpu.models.sync import export_txns_since
+from text_crdt_rust_tpu.ops import batch as B
+from text_crdt_rust_tpu.ops import rle as R
+from text_crdt_rust_tpu.ops import rle_mixed as RM
+from text_crdt_rust_tpu.ops import span_arrays as SA
+from text_crdt_rust_tpu.utils.randedit import make_storm
+
+from test_device_flat import (
+    oracle_from_patches,
+    random_patches,
+)
+
+ROOT = RemoteId("ROOT", 0xFFFFFFFF)
+
+
+def replay_txns(txns, capacity, block_k=8, lmax=4, chunk=128):
+    table = B.AgentTable()
+    for t in txns:
+        table.add(t.id.agent)
+        for op in t.ops:
+            if hasattr(op, "id"):
+                table.add(op.id.agent)
+    ops, _ = B.compile_remote_txns(txns, table, lmax=lmax, dmax=16)
+    res = RM.replay_mixed_rle(ops, capacity=capacity, batch=8,
+                              block_k=block_k, chunk=chunk, interpret=True)
+    return R.rle_to_flat(ops, res)
+
+
+def oracle_txns(txns):
+    doc = ListCRDT()
+    for t in txns:
+        doc.apply_remote_txn(t)
+    return doc
+
+
+class TestMixedRleLocal:
+    def test_local_stream_matches_rle(self):
+        # KIND_LOCAL handling must stay bit-identical to ops.rle.
+        rng = random.Random(13)
+        patches, content = random_patches(rng, 60)
+        merged = B.merge_patches(patches)
+        ops, _ = B.compile_local_patches(merged, lmax=8, dmax=None)
+        res = RM.replay_mixed_rle(ops, capacity=256, batch=8, block_k=8,
+                                  chunk=128, interpret=True)
+        doc = R.rle_to_flat(ops, res)
+        ref = R.replay_local_rle(ops, capacity=256, batch=8, block_k=8,
+                                 chunk=128, interpret=True)
+        ref_doc = R.rle_to_flat(ops, ref)
+        assert SA.to_string(doc) == SA.to_string(ref_doc) == content
+        assert SA.doc_spans(doc) == SA.doc_spans(ref_doc)
+
+
+class TestMixedRleRemote:
+    def test_concurrent_root_inserts_tiebreak(self):
+        # Config-4 storm shape: peers insert at the same point with the
+        # same origins; order = the name tiebreak (`doc.rs:206-216`).
+        txns = [
+            RemoteTxn(id=RemoteId(name, 0), parents=[],
+                      ops=[RemoteIns(ROOT, ROOT, text)])
+            for name, text in [("zed", "zz"), ("amy", "aa"), ("mia", "mm")]
+        ]
+        oracle = oracle_txns(txns)
+        doc = replay_txns(txns, capacity=64, block_k=8)
+        assert SA.to_string(doc) == oracle.to_string()
+        assert SA.doc_spans(doc) == oracle.doc_spans()
+
+    @pytest.mark.parametrize("seed", [3, 21])
+    def test_two_peer_random_merge(self, seed):
+        rng = random.Random(seed)
+        pa, _ = random_patches(rng, 40)
+        pb, _ = random_patches(rng, 40)
+        a = oracle_from_patches(pa, agent="peer-a")
+        bdoc = oracle_from_patches(pb, agent="peer-b")
+        txns = export_txns_since(a, 0) + export_txns_since(bdoc, 0)
+        oracle = oracle_txns(txns)
+        doc = replay_txns(txns, capacity=512, block_k=8)
+        assert SA.to_string(doc) == oracle.to_string()
+        assert SA.doc_spans(doc) == oracle.doc_spans()
+
+    def test_remote_delete_fragmented_and_double(self):
+        base = RemoteTxn(id=RemoteId("amy", 0), parents=[],
+                         ops=[RemoteIns(ROOT, ROOT, "abcdef")])
+        d1 = RemoteTxn(id=RemoteId("bob", 0), parents=[RemoteId("amy", 5)],
+                       ops=[RemoteDel(RemoteId("amy", 1), 3)])
+        d2 = RemoteTxn(id=RemoteId("cat", 0), parents=[RemoteId("amy", 5)],
+                       ops=[RemoteDel(RemoteId("amy", 2), 3)])
+        txns = [base, d1, d2]
+        oracle = oracle_txns(txns)
+        doc = replay_txns(txns, capacity=64, block_k=8)
+        assert SA.to_string(doc) == oracle.to_string() == "af"
+        assert SA.doc_spans(doc) == oracle.doc_spans()
+
+    def test_local_remote_convergence(self):
+        # The reference's `remote_txns` convergence check (`doc.rs:620-676`).
+        rng = random.Random(5)
+        patches, _ = random_patches(rng, 60)
+        local = oracle_from_patches(patches, agent="conv")
+        txns = export_txns_since(local, 0)
+        doc = replay_txns(txns, capacity=512, block_k=8)
+        assert SA.to_string(doc) == local.to_string()
+        assert SA.doc_spans(doc) == local.doc_spans()
+
+    def test_storm_interleaved_peers(self):
+        # N peers typing concurrently at interleaved positions, merged into
+        # one causal stream — splits hit between remote integrations,
+        # exercising the stale-index fallback + heal on run rows.
+        rng = random.Random(99)
+        peers = []
+        for name in ("ada", "bea", "cyd", "dot"):
+            patches, _ = random_patches(rng, 25)
+            peers.append(oracle_from_patches(patches, agent=name))
+        txns = []
+        for p in peers:
+            txns.extend(export_txns_since(p, 0))
+        oracle = oracle_txns(txns)
+        doc = replay_txns(txns, capacity=1024, block_k=8)
+        assert SA.to_string(doc) == oracle.to_string()
+        assert SA.doc_spans(doc) == oracle.doc_spans()
+
+    def test_long_remote_delete_chunked(self):
+        # A delete run longer than dmax=16 must chunk and still converge.
+        base = RemoteTxn(id=RemoteId("amy", 0), parents=[],
+                         ops=[RemoteIns(ROOT, ROOT, "x" * 50)])
+        kill = RemoteTxn(id=RemoteId("bob", 0), parents=[RemoteId("amy", 49)],
+                         ops=[RemoteDel(RemoteId("amy", 5), 40)])
+        txns = [base, kill]
+        oracle = oracle_txns(txns)
+        doc = replay_txns(txns, capacity=128, block_k=16, lmax=16)
+        assert SA.to_string(doc) == oracle.to_string() == "x" * 10
+        assert SA.doc_spans(doc) == oracle.doc_spans()
+
+    def test_delete_inside_merged_run_then_insert(self):
+        # Insert into the middle of a TOMBSTONE run: the raw-position
+        # splice must preserve the dead tail's sign/start (the
+        # `_insert_splice_raw` negative-run fix-up).
+        base = RemoteTxn(id=RemoteId("amy", 0), parents=[],
+                         ops=[RemoteIns(ROOT, ROOT, "abcdefgh")])
+        kill = RemoteTxn(id=RemoteId("amy", 8), parents=[RemoteId("amy", 7)],
+                         ops=[RemoteDel(RemoteId("amy", 2), 4)])
+        # bob saw only the base: inserts between d (amy,3) and e (amy,4),
+        # both of which are now tombstones.
+        mid = RemoteTxn(id=RemoteId("bob", 0), parents=[RemoteId("amy", 7)],
+                        ops=[RemoteIns(RemoteId("amy", 3),
+                                       RemoteId("amy", 4), "XY")])
+        txns = [base, kill, mid]
+        oracle = oracle_txns(txns)
+        doc = replay_txns(txns, capacity=64, block_k=8)
+        assert SA.to_string(doc) == oracle.to_string()
+        assert SA.doc_spans(doc) == oracle.doc_spans()
+
+    def test_config4_storm_oracle(self):
+        # The bench config-4 workload shape end-to-end.
+        txns, receiver = make_storm(4, 6, 2, seed=7)
+        oracle = oracle_txns(txns)
+        assert oracle.to_string() == receiver.to_string()
+        doc = replay_txns(txns, capacity=512, block_k=8, lmax=8)
+        assert SA.to_string(doc) == receiver.to_string()
+        assert SA.doc_spans(doc) == oracle.doc_spans()

@@ -20,10 +20,14 @@ see ``span_arrays``) and cross-check bit-identically in ``tests/``:
 - ``rle_lanes`` — per-lane DIVERGENT documents: B distinct streams, one
                   op per lane per step, warm-startable across compiled
                   chunks (the streaming config-5 engine).
+- ``rle_mixed`` — the round-4 unification: the FULL op surface (local +
+                  remote YATA integrate + remote delete, `doc.rs:242-348`)
+                  on the run representation — runs the config-4 storm on
+                  state that is runs, not chars.
 - ``blocked`` / ``blocked_hbm`` — the round-2 per-character block
                   engines (kept as references and for the unmerged-stream
                   path); ``blocked_mixed`` adds the remote-op hot path
-                  in-kernel (concurrent-insert storms, config 4).
+                  in-kernel on char rows (superseded by ``rle_mixed``).
 
 ``batch`` compiles editing traces into fixed-shape op tensors (the
 host-side analog of the reference's bench replay loop,

@@ -263,7 +263,13 @@ def merge_patches(patches: Sequence[TestPatch]) -> List[TestPatch]:
       between the coalesced patches;
     - delete runs: the same char set is tombstoned and the same number
       of orders is consumed (order totals are preserved patch-for-patch),
-      so device state and ``next_order`` are bit-identical;
+      so device state and ``next_order`` are bit-identical.  CAVEAT
+      (advisor r3): coalescing a BACKSPACE run into one forward delete
+      span reverses the delete-order -> target-char attribution relative
+      to the unmerged stream (final state, origins and next_order are
+      unchanged, but a per-delete-op version log derived from a merged
+      stream would attribute delete orders to the wrong chars — emit
+      such logs from the unmerged stream, as ``models.sync`` does);
     - mixed (delete+insert) patches and any position discontinuity break
       the run, so no reordering across unrelated edits ever happens.
 

@@ -231,43 +231,50 @@ def _rle_kernel(
     def split(l):
         """Leaf split (`mutations.rs:623-669`): move the top half of slot
         ``l``'s rows to a fresh physical block and splice it into the
-        logical order at ``l+1``. O(K); never a global rebalance."""
+        logical order at ``l+1``. O(K); never a global rebalance.
+
+        At table capacity the split is a NO-OP with the error flag
+        raised (advisor r3: proceeding overwrote an in-use physical
+        block and left duplicate blkord entries — silent corruption for
+        raw-state readers that skip ``check()``)."""
         nlog = meta[0]
 
         @pl.when(nlog >= NB)
         def _cap():
             err_ref[0:1, :] = jnp.ones((1, B), jnp.int32)
 
-        b = slot_scalar(blkord, l)
-        r = slot_scalar(rws, l)
-        keep = r // 2
-        mv = r - keep
-        nb = jnp.minimum(nlog, NB - 1)  # fresh physical block id
-        bo = ordp[pl.ds(b * K, K), :]
-        bl = lenp[pl.ds(b * K, K), :]
-        liv_hi = _lane_scalar(jnp.where(
-            (idx_k >= keep) & (idx_k < r) & (bo > 0), bl, 0))
-        liv_lo = slot_scalar(liv, l) - liv_hi
+        @pl.when(nlog < NB)
+        def _do():
+            b = slot_scalar(blkord, l)
+            r = slot_scalar(rws, l)
+            keep = r // 2
+            mv = r - keep
+            nb = nlog  # fresh physical block id
+            bo = ordp[pl.ds(b * K, K), :]
+            bl = lenp[pl.ds(b * K, K), :]
+            liv_hi = _lane_scalar(jnp.where(
+                (idx_k >= keep) & (idx_k < r) & (bo > 0), bl, 0))
+            liv_lo = slot_scalar(liv, l) - liv_hi
 
-        up_o = _shift_rows_up(bo, keep, K)
-        up_l = _shift_rows_up(bl, keep, K)
-        new_mask = idx_k < mv
-        ordp[pl.ds(nb * K, K), :] = jnp.where(new_mask, up_o, 0)
-        lenp[pl.ds(nb * K, K), :] = jnp.where(new_mask, up_l, 0)
-        keep_mask = idx_k < keep
-        ordp[pl.ds(b * K, K), :] = jnp.where(keep_mask, bo, 0)
-        lenp[pl.ds(b * K, K), :] = jnp.where(keep_mask, bl, 0)
+            up_o = _shift_rows_up(bo, keep, K)
+            up_l = _shift_rows_up(bl, keep, K)
+            new_mask = idx_k < mv
+            ordp[pl.ds(nb * K, K), :] = jnp.where(new_mask, up_o, 0)
+            lenp[pl.ds(nb * K, K), :] = jnp.where(new_mask, up_l, 0)
+            keep_mask = idx_k < keep
+            ordp[pl.ds(b * K, K), :] = jnp.where(keep_mask, bo, 0)
+            lenp[pl.ds(b * K, K), :] = jnp.where(keep_mask, bl, 0)
 
-        # Splice the new block into the logical order at slot l+1.
-        for tbl in (blkord, rws, liv):
-            shifted = _shift_rows(tbl[:], 1, 1)
-            tbl[:] = jnp.where(idx_l <= l, tbl[:], shifted)
-        rws[pl.ds(l, 1), :] = jnp.broadcast_to(keep, (1, B))
-        liv[pl.ds(l, 1), :] = jnp.broadcast_to(liv_lo, (1, B))
-        blkord[pl.ds(l + 1, 1), :] = jnp.broadcast_to(nb, (1, B))
-        rws[pl.ds(l + 1, 1), :] = jnp.broadcast_to(mv, (1, B))
-        liv[pl.ds(l + 1, 1), :] = jnp.broadcast_to(liv_hi, (1, B))
-        meta[0] = nlog + 1
+            # Splice the new block into the logical order at slot l+1.
+            for tbl in (blkord, rws, liv):
+                shifted = _shift_rows(tbl[:], 1, 1)
+                tbl[:] = jnp.where(idx_l <= l, tbl[:], shifted)
+            rws[pl.ds(l, 1), :] = jnp.broadcast_to(keep, (1, B))
+            liv[pl.ds(l, 1), :] = jnp.broadcast_to(liv_lo, (1, B))
+            blkord[pl.ds(l + 1, 1), :] = jnp.broadcast_to(nb, (1, B))
+            rws[pl.ds(l + 1, 1), :] = jnp.broadcast_to(mv, (1, B))
+            liv[pl.ds(l + 1, 1), :] = jnp.broadcast_to(liv_hi, (1, B))
+            meta[0] = nlog + 1
 
     def find_insert_slot(p):
         l = jnp.where(p == 0, 0, slot_of_live_rank(p))

@@ -163,37 +163,41 @@ def _rle_hbm_kernel(
 
         @pl.when(nlog >= NB)
         def _cap():
+            # NO-OP at table capacity (advisor r3: proceeding overwrote
+            # an in-use physical block); flag and leave state readable.
             err_ref[0:1, :] = jnp.ones((1, B), jnp.int32)
 
-        b = slot_scalar(blkord, l)
-        ensure(b)
-        r = slot_scalar(rws, l)
-        keep = r // 2
-        mv = r - keep
-        nb = jnp.minimum(nlog, NB - 1)
-        bo = wo[:]
-        bl = wl[:]
-        liv_hi = jnp.max(jnp.sum(jnp.where(
-            (idx_k >= keep) & (idx_k < r) & (bo > 0), bl, 0), axis=0))
-        liv_lo = slot_scalar(liv, l) - liv_hi
+        @pl.when(nlog < NB)
+        def _do():
+            b = slot_scalar(blkord, l)
+            ensure(b)
+            r = slot_scalar(rws, l)
+            keep = r // 2
+            mv = r - keep
+            nb = nlog
+            bo = wo[:]
+            bl = wl[:]
+            liv_hi = jnp.max(jnp.sum(jnp.where(
+                (idx_k >= keep) & (idx_k < r) & (bo > 0), bl, 0), axis=0))
+            liv_lo = slot_scalar(liv, l) - liv_hi
 
-        stage[:] = jnp.where(idx_k < mv, _shift_rows_up(bo, keep, K), 0)
-        dma(stage, ordp.at[pl.ds(gbase + nb * K, K), :])
-        stage[:] = jnp.where(idx_k < mv, _shift_rows_up(bl, keep, K), 0)
-        dma(stage, lenp.at[pl.ds(gbase + nb * K, K), :])
-        wo[:] = jnp.where(idx_k < keep, bo, 0)
-        wl[:] = jnp.where(idx_k < keep, bl, 0)
+            stage[:] = jnp.where(idx_k < mv, _shift_rows_up(bo, keep, K), 0)
+            dma(stage, ordp.at[pl.ds(gbase + nb * K, K), :])
+            stage[:] = jnp.where(idx_k < mv, _shift_rows_up(bl, keep, K), 0)
+            dma(stage, lenp.at[pl.ds(gbase + nb * K, K), :])
+            wo[:] = jnp.where(idx_k < keep, bo, 0)
+            wl[:] = jnp.where(idx_k < keep, bl, 0)
 
-        for tbl in (blkord, rws, liv):
-            shifted = _shift_rows(tbl[:], 1, 1)
-            tbl[:] = jnp.where(idx_l <= l, tbl[:], shifted)
-        rws[pl.ds(l, 1), :] = jnp.broadcast_to(keep, (1, B))
-        liv[pl.ds(l, 1), :] = jnp.broadcast_to(liv_lo, (1, B))
-        blkord[pl.ds(l + 1, 1), :] = jnp.broadcast_to(nb, (1, B))
-        rws[pl.ds(l + 1, 1), :] = jnp.broadcast_to(mv, (1, B))
-        liv[pl.ds(l + 1, 1), :] = jnp.broadcast_to(liv_hi, (1, B))
-        meta[0] = nlog + 1
-        resup()
+            for tbl in (blkord, rws, liv):
+                shifted = _shift_rows(tbl[:], 1, 1)
+                tbl[:] = jnp.where(idx_l <= l, tbl[:], shifted)
+            rws[pl.ds(l, 1), :] = jnp.broadcast_to(keep, (1, B))
+            liv[pl.ds(l, 1), :] = jnp.broadcast_to(liv_lo, (1, B))
+            blkord[pl.ds(l + 1, 1), :] = jnp.broadcast_to(nb, (1, B))
+            rws[pl.ds(l + 1, 1), :] = jnp.broadcast_to(mv, (1, B))
+            liv[pl.ds(l + 1, 1), :] = jnp.broadcast_to(liv_hi, (1, B))
+            meta[0] = nlog + 1
+            resup()
 
     def find_insert_slot(p):
         l = jnp.where(p == 0, 0, slot_of_live_rank(p))

@@ -1,0 +1,754 @@
+"""Mixed-stream RLE run engine: remote ops (hot path #2) on RUN rows.
+
+``ops.rle`` is the north-star local-replay engine: device state is the
+RLE run (`src/list/span.rs:6-119` semantics, ~40x fewer rows than chars)
+but it refuses remote ops.  ``ops.blocked_mixed`` applies remote ops
+(YATA integrate, `doc.rs:167-234`) but on ONE ROW PER CHARACTER.  This
+engine is the round-4 unification the r3 verdict demanded: the full op
+surface — KIND_LOCAL, KIND_REMOTE_INS, KIND_REMOTE_DEL — applied
+directly to the run representation, so the `doc.rs:242-348` hot path
+(the reference's raison d'etre) runs on state that is runs, not chars.
+
+What the remote paths add on top of ``ops.rle``'s block grid:
+
+- **a RAW per-slot count** (``raw``) next to the live count: remote
+  cursors are RAW positions (tombstones not skipped, `doc.rs:452`), so
+  the block descent needs the `FullIndex` pair (`index.rs:100-158`) —
+  live sums for local edits, raw sums for integrate cursors.
+- **order -> physical-block index** (``ordblk``, the `markers.rs:8` /
+  `split_list/mod.rs:440` SpaceIndex analog) packed 128 orders/row.
+  Maintained per insert; a block split moves rows and deliberately
+  leaves entries stale — lookups verify containment against the hinted
+  block (runs make that a range test, not an equality test) and fall
+  back to ONE vectorized full-plane search, then self-heal.
+- **by-order origin/rank tables** (``oll/orl/rkl``) prefilled host-side
+  (`batch.prefill_logs`), updated in-kernel by local inserts — the YATA
+  scan reads per-ORDER origins, which the prefilled implicit chain
+  (`span.rs:24-28`) provides for mid-run chars.
+- **run-level YATA integrate**: the reference's conflict scan walks
+  items one at a time (`doc.rs:183-222`); on runs, every non-head char
+  of a run has ``origin_left == its own predecessor`` so the scan can
+  only break mid-run at the op's ``origin_right`` — each loop iteration
+  therefore consumes a WHOLE run (or jumps straight to origin_right
+  inside it), shrinking the scan by the run factor.
+- **run-level remote delete**: a bitmask walk over the <= ``dmax``-long
+  target order range; each iteration resolves the lowest unhandled
+  order to its run, splits that run at the covered sub-range (<= 3
+  parts, tombstone mid), and clears the whole covered span's bits at
+  once.  Already-dead runs retire their bits without flipping
+  (idempotent concurrent deletes, `double_delete.rs:6-9`; excess
+  counting stays host-side per SURVEY).
+
+Same lane batching as ``ops.rle`` (all docs replay one shared stream),
+same ``RleResult`` / ``rle_to_flat`` result surface.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .batch import (
+    KIND_LOCAL,
+    KIND_REMOTE_DEL,
+    KIND_REMOTE_INS,
+    OpTensors,
+    prefill_logs,
+)
+from .blocked import _cumsum_rows, _lane_scalar, _require, _shift_rows
+from .rle import (
+    RleResult,
+    _delete_block_math,
+    _insert_splice,
+    _locate_run,
+    _row_scalar,
+    _shift_rows_up,
+)
+from .span_arrays import make_flat_doc
+
+LANES = 128  # orders per by-order table row
+
+
+def _locate_run_raw(bo, bl, idx_k, r0, local):
+    """Raw-position twin of ``rle._locate_run``: find the run containing
+    RAW char #``local`` (1-based, tombstones counted).  Returns
+    ``(i_r, o_r, l_r, off)`` with ``off`` the 1-based char offset."""
+    cum = _cumsum_rows(bl)
+    i_r = jnp.max(jnp.sum(
+        ((cum < local) & (idx_k < r0)).astype(jnp.int32), axis=0))
+    o_r = _row_scalar(bo, i_r, idx_k)
+    l_r = _row_scalar(bl, i_r, idx_k)
+    off = local - (_row_scalar(cum, i_r, idx_k) - l_r)
+    return i_r, o_r, l_r, off
+
+
+def _insert_splice_raw(bo, bl, idx_k, c, i_r, o_r, l_r, off, il, st):
+    """Raw-position twin of ``rle._insert_splice``: splice a new LIVE run
+    (orders ``st..st+il``) at raw position ``c`` of a block.  Differences
+    from the live-rank path: the split run may be a TOMBSTONE (sign must
+    be preserved on the tail: a dead run's tail starts at
+    ``-(|start|+off)``), and the merge fast path additionally requires
+    the preceding run to be live (same-sign append, `span.rs:47-53`)."""
+    mrg = (c > 0) & (o_r > 0) & (off == l_r) & ((st + 1) == (o_r + l_r))
+    is_split = (c > 0) & (off < l_r)
+    ins_at = jnp.where(c == 0, 0, i_r + 1)
+    amt = jnp.where(mrg, 0, jnp.where(is_split, 2, 1))
+    so = _shift_rows(bo, amt, 2)
+    sl = _shift_rows(bl, amt, 2)
+    no = jnp.where(idx_k < ins_at, bo, so)
+    nl = jnp.where(idx_k < ins_at, bl, sl)
+    nl = jnp.where(is_split & (idx_k == i_r), off, nl)
+    new_run = (idx_k == ins_at) & jnp.logical_not(mrg)
+    no = jnp.where(new_run, st + 1, no)
+    nl = jnp.where(new_run, il, nl)
+    tail = is_split & (idx_k == ins_at + 1)
+    tail_o = jnp.where(o_r > 0, o_r + off, o_r - off)
+    no = jnp.where(tail, tail_o, no)
+    nl = jnp.where(tail, l_r - off, nl)
+    nl = jnp.where(mrg & (idx_k == i_r), l_r + il, nl)
+    return no, nl, amt, mrg, is_split
+
+
+class RleMixedResult(RleResult):
+    """``RleResult`` + the order-index error flag (err row 2)."""
+
+    def check(self) -> None:
+        super().check()
+        err = np.asarray(self.err)
+        if err[2].max() != 0:
+            raise RuntimeError(
+                "order index lookup missed: an op referenced an order "
+                "absent from device state (corrupt stream or engine bug)")
+
+
+def _mixed_rle_kernel(
+    kind_ref, pos_ref, dlen_ref, dtgt_ref, olop_ref, orop_ref, rk_ref,
+    ilen_ref, start_ref,                        # [CHUNK] SMEM op columns
+    oll_in, orl_in, rkl_in,                     # [OT, 128] by-order tables
+    ol_ref, or_ref,                             # [CHUNK, B] outputs
+    ordp, lenp,                                 # [CAP, B] run planes (OUT
+                                                #   blocks as working state)
+    blk_out, rows_out, meta_out, err_ref,       # tables + flags
+    blkord, rws, liv, raw, ordblk, oll, orl,    # VMEM scratch
+    meta,                                       # SMEM scratch
+    *, K: int, NB: int, NBL: int, CHUNK: int, OT: int, DMAX: int,
+):
+    B = ordp.shape[1]
+    CAP = K * NB
+    i = pl.program_id(0)
+    last = pl.num_programs(0) - 1
+    idx_k = lax.broadcasted_iota(jnp.int32, (K, B), 0)
+    idx_l = lax.broadcasted_iota(jnp.int32, (NBL, B), 0)
+    idx_cap = lax.broadcasted_iota(jnp.int32, (CAP, B), 0)
+    lane = lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
+    lane2 = lax.broadcasted_iota(jnp.int32, (2, LANES), 1)
+    row2 = lax.broadcasted_iota(jnp.int32, (2, LANES), 0)
+    root_i = jnp.int32(-1)  # ROOT_ORDER as i32
+    root_u = jnp.uint32(0xFFFFFFFF)
+
+    ol_ref[:] = jnp.zeros_like(ol_ref)
+    or_ref[:] = jnp.zeros_like(or_ref)
+
+    @pl.when(i == 0)
+    def _init():
+        ordp[:] = jnp.zeros_like(ordp)
+        lenp[:] = jnp.zeros_like(lenp)
+        blkord[:] = jnp.zeros_like(blkord)
+        rws[:] = jnp.zeros_like(rws)
+        liv[:] = jnp.zeros_like(liv)
+        raw[:] = jnp.zeros_like(raw)
+        ordblk[:] = jnp.zeros_like(ordblk)
+        err_ref[:] = jnp.zeros_like(err_ref)
+        oll[:] = oll_in[:]
+        orl[:] = orl_in[:]
+        meta[0] = 1  # logical blocks in use
+
+    # ---- by-order tables (order o lives at [o // 128, o % 128]) ---------
+
+    def tab_read(tab, o):
+        r = tab[pl.ds(o // LANES, 1), :]
+        return jnp.sum(jnp.where(lane == o % LANES, r, 0))
+
+    def tab_write(tab, o, v):
+        r = tab[pl.ds(o // LANES, 1), :]
+        tab[pl.ds(o // LANES, 1), :] = jnp.where(lane == o % LANES, v, r)
+
+    def tab_write_run(tab, start, run_len, v):
+        """tab[start : start+run_len] = v; run_len <= 128, so a 2-row
+        window always covers it (tables keep a spare tail row)."""
+        r0 = start // LANES
+        w = tab[pl.ds(r0, 2), :]
+        g = row2 * LANES + lane2 + r0 * LANES
+        hit = (g >= start) & (g < start + run_len)
+        tab[pl.ds(r0, 2), :] = jnp.where(hit, v, w)
+
+    # ---- slot plumbing (logical block tables) ---------------------------
+
+    def slot_scalar(tbl, l):
+        return _lane_scalar(jnp.where(idx_l == l, tbl[:], 0))
+
+    def sum_before_slot(tbl, l):
+        return _lane_scalar(jnp.where(idx_l < l, tbl[:], 0))
+
+    def slot_of_cum(tbl, rank1):
+        """Smallest logical slot whose cumulative ``tbl`` count reaches
+        ``rank1`` (the `root.rs:54-88` descent over block sums; ``tbl`` =
+        liv for content cursors, raw for raw cursors — `index.rs:100`)."""
+        nlog = meta[0]
+        cum = _cumsum_rows(jnp.where(idx_l < nlog, tbl[:], 0))
+        hit = (cum < rank1) & (idx_l < nlog)
+        return jnp.minimum(
+            jnp.max(jnp.sum(hit.astype(jnp.int32), axis=0)), nlog - 1)
+
+    def logical_of_physical(b):
+        """Slot holding physical block ``b`` (small NBL scan)."""
+        nlog = meta[0]
+        hit = (blkord[:] == b) & (idx_l < nlog)
+        return jnp.max(jnp.where(hit, idx_l, 0))
+
+    def split(l):
+        """Leaf split (`mutations.rs:623-669`): move the top half of slot
+        ``l``'s rows to a fresh physical block spliced into the logical
+        order at ``l+1``.  At table capacity the split is a NO-OP with the
+        error flag raised (advisor r3: proceeding overwrote a live block).
+        ``ordblk`` entries of moved rows go stale; lookups self-heal."""
+        nlog = meta[0]
+
+        @pl.when(nlog >= NB)
+        def _cap():
+            err_ref[0:1, :] = jnp.ones((1, B), jnp.int32)
+
+        @pl.when(nlog < NB)
+        def _do():
+            b = slot_scalar(blkord, l)
+            r = slot_scalar(rws, l)
+            keep = r // 2
+            mv = r - keep
+            nb = nlog  # fresh physical block id
+            bo = ordp[pl.ds(b * K, K), :]
+            bl = lenp[pl.ds(b * K, K), :]
+            hi_mask = (idx_k >= keep) & (idx_k < r)
+            liv_hi = _lane_scalar(jnp.where(hi_mask & (bo > 0), bl, 0))
+            raw_hi = _lane_scalar(jnp.where(hi_mask, bl, 0))
+            liv_lo = slot_scalar(liv, l) - liv_hi
+            raw_lo = slot_scalar(raw, l) - raw_hi
+
+            up_o = _shift_rows_up(bo, keep, K)
+            up_l = _shift_rows_up(bl, keep, K)
+            new_mask = idx_k < mv
+            ordp[pl.ds(nb * K, K), :] = jnp.where(new_mask, up_o, 0)
+            lenp[pl.ds(nb * K, K), :] = jnp.where(new_mask, up_l, 0)
+            keep_mask = idx_k < keep
+            ordp[pl.ds(b * K, K), :] = jnp.where(keep_mask, bo, 0)
+            lenp[pl.ds(b * K, K), :] = jnp.where(keep_mask, bl, 0)
+
+            for tbl in (blkord, rws, liv, raw):
+                shifted = _shift_rows(tbl[:], 1, 1)
+                tbl[:] = jnp.where(idx_l <= l, tbl[:], shifted)
+            rws[pl.ds(l, 1), :] = jnp.broadcast_to(keep, (1, B))
+            liv[pl.ds(l, 1), :] = jnp.broadcast_to(liv_lo, (1, B))
+            raw[pl.ds(l, 1), :] = jnp.broadcast_to(raw_lo, (1, B))
+            blkord[pl.ds(l + 1, 1), :] = jnp.broadcast_to(nb, (1, B))
+            rws[pl.ds(l + 1, 1), :] = jnp.broadcast_to(mv, (1, B))
+            liv[pl.ds(l + 1, 1), :] = jnp.broadcast_to(liv_hi, (1, B))
+            raw[pl.ds(l + 1, 1), :] = jnp.broadcast_to(raw_hi, (1, B))
+            meta[0] = nlog + 1
+
+    # ---- order -> run lookup (the SpaceIndex, `split_list/mod.rs:440`) --
+
+    def find_in_block(b, o):
+        """(found, row) of the run CONTAINING order ``o`` in block ``b``
+        (a range test: runs make the index 1-per-run, not 1-per-char)."""
+        bo = ordp[pl.ds(b * K, K), :]
+        bl = lenp[pl.ds(b * K, K), :]
+        so = jnp.abs(bo) - 1
+        hit = (bo != 0) & (so <= o) & (o < so + bl)
+        found = _lane_scalar(hit.astype(jnp.int32)) > 0
+        row = jnp.max(jnp.min(jnp.where(hit, idx_k, K), axis=0))
+        return found, row
+
+    def locate_order(o):
+        """(physical block, row) of the run containing order ``o``.
+        ``ordblk`` is a HINT — splits leave it stale; verify, fall back to
+        one vectorized full-plane search, self-heal the entry.
+
+        Callers may pass the ROOT sentinel (-1) from a discarded
+        ``jnp.where`` branch (both branches evaluate): the lookup then
+        returns in-range garbage without raising the miss flag or
+        touching the hint table."""
+        oc = jnp.maximum(o, 0)
+        bh = jnp.clip(tab_read(ordblk, oc), 0, NB - 1)
+        f, row = find_in_block(bh, oc)
+
+        def fallback():
+            so = jnp.abs(ordp[:]) - 1
+            hit = (ordp[:] != 0) & (so <= oc) & (oc < so + lenp[:])
+            g = jnp.max(jnp.min(jnp.where(hit, idx_cap, CAP - 1), axis=0))
+            ok = _lane_scalar(hit.astype(jnp.int32)) > 0
+
+            @pl.when(~ok & (o >= 0))
+            def _missing():
+                err_ref[2:3, :] = jnp.ones((1, B), jnp.int32)
+
+            return g // K, g % K
+
+        b, row = lax.cond(f, lambda: (bh, row), fallback)
+
+        @pl.when(o >= 0)
+        def _heal():
+            tab_write(ordblk, oc, b)
+
+        return b, row
+
+    def pos_of_order(o):
+        """RAW document position of the char with order ``o``."""
+        b, row = locate_order(o)
+        l = logical_of_physical(b)
+        bo = ordp[pl.ds(b * K, K), :]
+        bl = lenp[pl.ds(b * K, K), :]
+        raw_before = _lane_scalar(jnp.where(idx_k < row, bl, 0))
+        so_row = jnp.abs(_row_scalar(bo, row, idx_k)) - 1
+        return sum_before_slot(raw, l) + raw_before + (o - so_row)
+
+    def cursor_after(o):
+        return jnp.where(o == root_i, 0, pos_of_order(o) + 1)
+
+    def run_at_raw(c):
+        """Signed start order, length, and 0-based char offset of the run
+        holding RAW position ``c``."""
+        l = slot_of_cum(raw, c + 1)
+        b = slot_scalar(blkord, l)
+        r0 = slot_scalar(rws, l)
+        local = c - sum_before_slot(raw, l)
+        bo = ordp[pl.ds(b * K, K), :]
+        bl = lenp[pl.ds(b * K, K), :]
+        cum = _cumsum_rows(bl)
+        i_r = jnp.max(jnp.sum(
+            ((cum <= local) & (idx_k < r0)).astype(jnp.int32), axis=0))
+        o_r = _row_scalar(bo, i_r, idx_k)
+        l_r = _row_scalar(bl, i_r, idx_k)
+        off = local - (_row_scalar(cum, i_r, idx_k) - l_r)
+        return o_r, l_r, off
+
+    # ---- local ops (the ops.rle paths + raw/index/table upkeep) ---------
+
+    def find_insert_slot(p):
+        l = jnp.where(p == 0, 0, slot_of_cum(liv, p))
+        return l, slot_scalar(rws, l)
+
+    def record_insert(k, b, st, il, left, right):
+        """Index + origin-table upkeep and per-op origin outputs shared by
+        the local and remote insert paths."""
+        tab_write_run(ordblk, st, il, b)
+        tab_write(oll, st, left)
+        tab_write_run(orl, st, il, right)
+        ol_ref[pl.ds(k, 1), :] = jnp.broadcast_to(
+            left.astype(jnp.uint32), (1, B))
+        or_ref[pl.ds(k, 1), :] = jnp.broadcast_to(
+            right.astype(jnp.uint32), (1, B))
+
+    def do_local_insert(k, p, il, st):
+        """Insert an ``il``-char run after LIVE rank ``p``
+        (`mutations.rs:17-179`): <= 3 touched rows regardless of ``il``."""
+        l, r0 = find_insert_slot(p)
+
+        @pl.when(r0 + 2 > K)
+        def _():
+            split(l)
+
+        l, r0 = find_insert_slot(p)
+        b = slot_scalar(blkord, l)
+        base = sum_before_slot(liv, l)
+        local = p - base
+        bo = ordp[pl.ds(b * K, K), :]
+        bl = lenp[pl.ds(b * K, K), :]
+        i_r, o_r, l_r, off = _locate_run(bo, bl, idx_k, r0, local)
+        no, nl, amt, _mrg, is_split = _insert_splice(
+            bo, bl, idx_k, p, i_r, o_r, l_r, off, il, st)
+
+        left = jnp.where(p == 0, root_i,
+                         ((o_r - 1) + (off - 1)).astype(jnp.int32))
+        # Raw successor (`doc.rs:452`: tombstones not skipped); read from
+        # the PRE-splice block.
+        nxt_in_blk = _row_scalar(bo, i_r + 1, idx_k)  # 0 past the last row
+        nlog = meta[0]
+        b2 = slot_scalar(blkord, jnp.minimum(l + 1, NBL - 1))
+        nxt_slot_o = jnp.max(jnp.sum(jnp.where(
+            idx_k == 0, ordp[pl.ds(b2 * K, K), :], 0), axis=0))
+        succ_signed = jnp.where(
+            i_r + 1 < r0, nxt_in_blk,
+            jnp.where(l + 1 < nlog, nxt_slot_o, 0))
+        first_o = _row_scalar(bo, 0, idx_k)  # p == 0: the raw doc head
+        succ_p0 = jnp.where(r0 > 0, first_o, 0)
+        succ = jnp.where(p == 0, succ_p0,
+                         jnp.where(is_split, o_r + off, succ_signed))
+        right = jnp.where(succ == 0, root_i,
+                          (jnp.abs(succ) - 1).astype(jnp.int32))
+
+        ordp[pl.ds(b * K, K), :] = no
+        lenp[pl.ds(b * K, K), :] = nl
+        rws[pl.ds(l, 1), :] = rws[pl.ds(l, 1), :] + amt
+        liv[pl.ds(l, 1), :] = liv[pl.ds(l, 1), :] + il
+        raw[pl.ds(l, 1), :] = raw[pl.ds(l, 1), :] + il
+        record_insert(k, b, st, il, left, right)
+
+    def do_local_delete(p, d):
+        """Tombstone ``d`` live chars after live rank ``p`` (the
+        `mutations.rs:520-570` walk; raw counts are unchanged)."""
+
+        def body(carry):
+            rem, iters = carry
+            l = slot_of_cum(liv, p + 1)
+
+            @pl.when(slot_scalar(rws, l) + 2 > K)
+            def _():
+                split(l)
+
+            l = slot_of_cum(liv, p + 1)
+            b = slot_scalar(blkord, l)
+            base = sum_before_slot(liv, l)
+            bo = ordp[pl.ds(b * K, K), :]
+            bl = lenp[pl.ds(b * K, K), :]
+            no, nl, added, tot = _delete_block_math(
+                bo, bl, idx_k, K, base, p, rem)
+            ordp[pl.ds(b * K, K), :] = no
+            lenp[pl.ds(b * K, K), :] = nl
+            rws[pl.ds(l, 1), :] = rws[pl.ds(l, 1), :] + added
+            liv[pl.ds(l, 1), :] = liv[pl.ds(l, 1), :] - tot
+            return rem - tot, iters + 1
+
+        rem, _ = lax.while_loop(
+            lambda c: (c[0] > 0) & (c[1] <= 2 * NBL), body, (d, 0))
+
+        @pl.when(rem > 0)
+        def _bad_delete():
+            err_ref[1:2, :] = jnp.ones((1, B), jnp.int32)
+
+    # ---- remote insert (`doc.rs:274-293` -> integrate) ------------------
+
+    def integrate_cursor(my_rank, o_left, o_right):
+        """The YATA conflict scan (`doc.rs:183-222`) over RUNS: a run's
+        non-head chars have ``origin_left == own predecessor`` (olc ==
+        own position > left_cursor), so after evaluating a head char the
+        scan can only stop inside that run AT ``o_right`` — each
+        iteration consumes a whole run or jumps straight there.
+        Pinned-scan_start rule (tests/test_integrate_divergence.py)."""
+        cursor0 = cursor_after(o_left)
+        left_cursor = cursor0
+        n = sum_before_slot(raw, meta[0])
+
+        def cond(state):
+            cursor, scanning, scan_start, done = state
+            return ~done & (cursor < n)
+
+        def body(state):
+            cursor, scanning, scan_start, done = state
+            o_r, l_r, off = run_at_raw(cursor)
+            so = jnp.abs(o_r) - 1
+            other_order = so + off
+            other_left = tab_read(oll, other_order)
+            other_right = tab_read(orl, other_order)
+            other_rank = tab_read(rkl_in, other_order)
+            olc = cursor_after(other_left)
+            brk = (other_order == o_right) | (olc < left_cursor)
+            eq = ~brk & (olc == left_cursor)
+            gt = my_rank > other_rank
+            brk = brk | (eq & ~gt & (o_right == other_right))
+            starts_scan = eq & ~gt & (o_right != other_right)
+            new_scan_start = jnp.where(starts_scan & ~scanning, cursor,
+                                       scan_start)
+            new_scanning = jnp.where(
+                eq, jnp.where(gt, False, jnp.where(
+                    o_right == other_right, scanning, True)),
+                scanning,
+            )
+            # Run-skip: chars (off+1 .. l_r-1) all have olc == own
+            # position > left_cursor (no brk, no eq) — jump past them,
+            # stopping only at o_right if this run contains it.
+            contains_right = (o_right > other_order) & (o_right < so + l_r)
+            step = jnp.where(contains_right, o_right - other_order,
+                             l_r - off)
+            return (jnp.where(brk, cursor, cursor + step), new_scanning,
+                    new_scan_start, brk)
+
+        init = (cursor0, jnp.asarray(False), cursor0, jnp.asarray(False))
+        cursor, scanning, scan_start, _ = lax.while_loop(cond, body, init)
+        return jnp.where(scanning, scan_start, cursor)
+
+    def do_remote_insert(k, my_rank, o_left, o_right, il, st):
+        c = integrate_cursor(my_rank, o_left, o_right)
+        l = jnp.where(c == 0, 0, slot_of_cum(raw, c))
+
+        @pl.when(slot_scalar(rws, l) + 2 > K)
+        def _():
+            split(l)
+
+        l = jnp.where(c == 0, 0, slot_of_cum(raw, c))
+        b = slot_scalar(blkord, l)
+        r0 = slot_scalar(rws, l)
+        local = c - sum_before_slot(raw, l)
+        bo = ordp[pl.ds(b * K, K), :]
+        bl = lenp[pl.ds(b * K, K), :]
+        i_r, o_r, l_r, off = _locate_run_raw(bo, bl, idx_k, r0, local)
+        no, nl, amt, _mrg, _is_split = _insert_splice_raw(
+            bo, bl, idx_k, c, i_r, o_r, l_r, off, il, st)
+        ordp[pl.ds(b * K, K), :] = no
+        lenp[pl.ds(b * K, K), :] = nl
+        rws[pl.ds(l, 1), :] = rws[pl.ds(l, 1), :] + amt
+        liv[pl.ds(l, 1), :] = liv[pl.ds(l, 1), :] + il
+        raw[pl.ds(l, 1), :] = raw[pl.ds(l, 1), :] + il
+        record_insert(k, b, st, il, o_left, o_right)
+
+    # ---- remote delete (`doc.rs:295-340`) -------------------------------
+
+    def do_remote_delete(t, dlen):
+        """Tombstone orders [t, t+dlen).  A bit in ``mask`` = a target
+        order not yet accounted for; each iteration resolves the lowest
+        one to its RUN, splits the covered sub-range out as a tombstone
+        (<= 3 parts), and clears every covered bit at once."""
+        full = jnp.left_shift(jnp.int32(1), dlen) - 1
+
+        def body(carry):
+            mask, iters = carry
+            low = mask & (-mask)
+            # floor(log2) via scalar shifts — Mosaic has no scalar
+            # population-count.
+            v = low
+            k0 = jnp.int32(0)
+            for sh in (16, 8, 4, 2, 1):
+                ge = (v >> sh) != 0
+                k0 = k0 + jnp.where(ge, sh, 0)
+                v = jnp.where(ge, v >> sh, v)
+            o = t + k0
+            b, row = locate_order(o)
+            l = logical_of_physical(b)
+
+            @pl.when(slot_scalar(rws, l) + 2 > K)
+            def _():
+                split(l)
+
+            b, row = locate_order(o)
+            l = logical_of_physical(b)
+            bo = ordp[pl.ds(b * K, K), :]
+            bl = lenp[pl.ds(b * K, K), :]
+            o_r = _row_scalar(bo, row, idx_k)
+            l_r = _row_scalar(bl, row, idx_k)
+            so = jnp.abs(o_r) - 1
+            a = o - so
+            e = jnp.minimum(l_r, t + dlen - so)
+            cov = e - a
+            live = o_r > 0
+
+            @pl.when(live)
+            def _flip():
+                has_head = a > 0
+                has_tail = e < l_r
+                amt = has_head.astype(jnp.int32) + has_tail.astype(jnp.int32)
+                sh_o = _shift_rows(bo, amt, 2)
+                sh_l = _shift_rows(bl, amt, 2)
+                no = jnp.where(idx_k <= row, bo, sh_o)
+                nl = jnp.where(idx_k <= row, bl, sh_l)
+                # Part layout: [head?] [tombstone mid] [tail?].
+                p0o = jnp.where(has_head, o_r, -(so + a + 1))
+                p0l = jnp.where(has_head, a, cov)
+                p1o = jnp.where(has_head, -(so + a + 1), so + e + 1)
+                p1l = jnp.where(has_head, cov, l_r - e)
+                w0 = idx_k == row
+                no = jnp.where(w0, p0o, no)
+                nl = jnp.where(w0, p0l, nl)
+                w1 = (idx_k == row + 1) & (amt >= 1)
+                no = jnp.where(w1, p1o, no)
+                nl = jnp.where(w1, p1l, nl)
+                w2 = (idx_k == row + 2) & (amt == 2)
+                no = jnp.where(w2, so + e + 1, no)
+                nl = jnp.where(w2, l_r - e, nl)
+                ordp[pl.ds(b * K, K), :] = no
+                lenp[pl.ds(b * K, K), :] = nl
+                rws[pl.ds(l, 1), :] = rws[pl.ds(l, 1), :] + amt
+                liv[pl.ds(l, 1), :] = liv[pl.ds(l, 1), :] - cov
+
+            bits = jnp.left_shift(
+                jnp.left_shift(jnp.int32(1), cov) - 1, k0)
+            return mask & ~bits, iters + 1
+
+        mask, _ = lax.while_loop(
+            lambda c: (c[0] != 0) & (c[1] <= DMAX), body, (full, 0))
+
+        @pl.when(mask != 0)
+        def _bad():
+            err_ref[1:2, :] = jnp.ones((1, B), jnp.int32)
+
+    # ---- dispatch -------------------------------------------------------
+
+    def op_body(k, _):
+        kind = kind_ref[k]
+        p = pos_ref[k]
+        d = dlen_ref[k]
+        il = ilen_ref[k]
+        st = start_ref[k]
+
+        @pl.when((kind == KIND_LOCAL) & (d > 0))
+        def _():
+            do_local_delete(p, d)
+
+        @pl.when((kind == KIND_LOCAL) & (il > 0))
+        def _():
+            do_local_insert(k, p, il, st)
+
+        @pl.when((kind == KIND_REMOTE_INS) & (il > 0))
+        def _():
+            do_remote_insert(k, rk_ref[k], olop_ref[k], orop_ref[k], il, st)
+
+        @pl.when(kind == KIND_REMOTE_DEL)
+        def _():
+            do_remote_delete(dtgt_ref[k], d)
+
+        return 0
+
+    lax.fori_loop(0, CHUNK, op_body, 0)
+
+    @pl.when(i == last)
+    def _flush():
+        blk_out[:] = blkord[:][jnp.newaxis]
+        rows_out[:] = rws[:][jnp.newaxis]
+        row0 = lax.broadcasted_iota(jnp.int32, (1, 8, B), 1) == 0
+        meta_out[:] = jnp.where(row0, meta[0], 0)
+
+
+def make_replayer_rle_mixed(
+    ops: OpTensors,
+    capacity: int,
+    batch: int = 128,
+    block_k: int = 256,
+    chunk: int = 1024,
+    interpret: bool = False,
+):
+    """Stage a mixed local/remote op stream on the RUN representation and
+    build a jitted replayer.
+
+    ``capacity`` counts RUN rows (`ops.rle` contract).  Remote delete
+    runs must be pre-chunked to <= 16 targets per step
+    (``compile_remote_txns(..., dmax=16)``); insert chunks must be
+    <= 128 chars (the order-table write window).
+    """
+    kinds = np.asarray(ops.kind)
+    _require(kinds.ndim == 1, "rle-mixed engine takes one shared stream")
+    _require(capacity % block_k == 0,
+             f"capacity ({capacity}) must be a multiple of block_k "
+             f"({block_k})")
+    _require(interpret or chunk % 1024 == 0 or (
+        jax.default_backend() != "tpu"),
+        "chunk must be a multiple of 1024 on TPU")
+    NB = capacity // block_k
+    _require(NB >= 1, "need at least one block")
+    _require(block_k >= 8, "block_k must hold a few runs")
+    _require(ops.lmax <= LANES, (
+        f"insert chunks must be <= {LANES} chars for the order-table "
+        f"window (compile with lmax<={LANES})"))
+    NBLp = max(8, NB)
+    dlens = np.asarray(ops.del_len)[kinds == KIND_REMOTE_DEL]
+    dmax = 16
+    _require(dlens.size == 0 or int(dlens.max()) <= dmax, (
+        f"remote delete runs must be <= {dmax} targets per step "
+        f"(compile with dmax={dmax})"))
+
+    # By-order tables: everything the compiler knows (remote origins,
+    # within-run chains, ranks), packed 128 orders/row, i32 (ROOT -> -1
+    # by u32 wraparound).  One spare tail row for the 2-row run writes.
+    total_orders = int(np.asarray(ops.order_advance, dtype=np.int64).sum())
+    ocap = max(total_orders + ops.lmax, LANES)
+    OT = (ocap + LANES - 1) // LANES + 1
+    OT = ((OT + 7) // 8) * 8
+    doc0 = prefill_logs(make_flat_doc(8, OT * LANES), ops)
+
+    def table(x):
+        return jnp.asarray(
+            np.asarray(x, dtype=np.uint32).view(np.int32).reshape(OT, LANES))
+
+    oll0 = table(doc0.ol_log)
+    orl0 = table(doc0.or_log)
+    rkl0 = table(doc0.rank_log)
+
+    s = ops.num_steps
+    s_pad = max(((s + chunk - 1) // chunk) * chunk, chunk)
+    pad = ((0, s_pad - s),)
+
+    def padded(a):
+        return jnp.asarray(np.pad(
+            np.asarray(a, dtype=np.uint32).view(np.int32), pad))
+
+    staged = tuple(padded(c) for c in (
+        ops.kind, ops.pos, ops.del_len, ops.del_target, ops.origin_left,
+        ops.origin_right, ops.rank, ops.ins_len, ops.ins_order_start))
+
+    smem = lambda: pl.BlockSpec(
+        (chunk,), lambda i: (i,), memory_space=pltpu.SMEM)
+
+    def whole(shape):
+        return pl.BlockSpec(shape, lambda i: tuple(0 for _ in shape),
+                            memory_space=pltpu.VMEM)
+
+    call = pl.pallas_call(
+        partial(_mixed_rle_kernel, K=block_k, NB=NB, NBL=NBLp, CHUNK=chunk,
+                OT=OT, DMAX=dmax),
+        grid=(s_pad // chunk,),
+        in_specs=[smem() for _ in range(9)] + [
+            whole((OT, LANES)), whole((OT, LANES)), whole((OT, LANES))],
+        out_specs=[
+            pl.BlockSpec((chunk, batch), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((chunk, batch), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            whole((capacity, batch)),
+            whole((capacity, batch)),
+            whole((1, NBLp, batch)),
+            whole((1, NBLp, batch)),
+            whole((1, 8, batch)),
+            whole((8, batch)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((s_pad, batch), jnp.uint32),
+            jax.ShapeDtypeStruct((s_pad, batch), jnp.uint32),
+            jax.ShapeDtypeStruct((capacity, batch), jnp.int32),
+            jax.ShapeDtypeStruct((capacity, batch), jnp.int32),
+            jax.ShapeDtypeStruct((1, NBLp, batch), jnp.int32),
+            jax.ShapeDtypeStruct((1, NBLp, batch), jnp.int32),
+            jax.ShapeDtypeStruct((1, 8, batch), jnp.int32),
+            jax.ShapeDtypeStruct((8, batch), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((NBLp, batch), jnp.int32),       # blkord
+            pltpu.VMEM((NBLp, batch), jnp.int32),       # rws
+            pltpu.VMEM((NBLp, batch), jnp.int32),       # liv
+            pltpu.VMEM((NBLp, batch), jnp.int32),       # raw
+            pltpu.VMEM((OT, LANES), jnp.int32),         # ordblk
+            pltpu.VMEM((OT, LANES), jnp.int32),         # ol table
+            pltpu.VMEM((OT, LANES), jnp.int32),         # or table
+            pltpu.SMEM((2,), jnp.int32),                # meta
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=110 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )
+    jitted = jax.jit(lambda *a: call(*a))
+    tables = (oll0, orl0, rkl0)
+
+    def run() -> RleMixedResult:
+        ol, orr, ordp, lenp, blk, rows, meta, err = jitted(*staged, *tables)
+        return RleMixedResult(
+            ordp=ordp, lenp=lenp, blkord=blk[0], rows=rows[0], meta=meta[0],
+            ol=ol[:s], orr=orr[:s], err=err,
+            block_k=block_k, num_blocks=NB, batch=batch)
+
+    return run
+
+
+def replay_mixed_rle(ops: OpTensors, capacity: int, **kw) -> RleMixedResult:
+    """One-shot convenience wrapper over ``make_replayer_rle_mixed``."""
+    return make_replayer_rle_mixed(ops, capacity, **kw)()
