@@ -1,0 +1,218 @@
+"""Sequence-parallel MUTATION vs the single-device engine (8-dev mesh).
+
+One document sharded sp=8; a local-edit stream applied through
+``parallel.sp_apply`` must produce exactly the char sequence (orders +
+tombstone signs + content) the single-device run simulation and the
+string oracle produce — including inserts at shard boundaries, deletes
+spanning several shards, origin parity with ``ops.rle``, and the
+capacity error path.  Long-lived docs load a row-balanced snapshot first
+(``SpDoc.load``): a fresh sharded doc owns every rank in shard 0.
+"""
+import random
+
+import jax
+import numpy as np
+import pytest
+
+from text_crdt_rust_tpu.ops import batch as B
+from text_crdt_rust_tpu.parallel import make_mesh
+from text_crdt_rust_tpu.parallel.sp_apply import SpDoc
+from text_crdt_rust_tpu.utils.randedit import random_patches
+from text_crdt_rust_tpu.utils.testdata import TestPatch
+
+
+def sp_doc(shard_rows=64, sp=8):
+    mesh = make_mesh(sp=sp)
+    return SpDoc(mesh, shard_rows)
+
+
+def expected(patches):
+    s = ""
+    for p in patches:
+        s = s[:p.pos] + p.ins_content + s[p.pos + p.del_len:]
+    return s
+
+
+def apply_patches(doc, patches, lmax=8, start_order=0):
+    ops, nxt = B.compile_local_patches(
+        B.merge_patches(patches), lmax=lmax, dmax=None,
+        start_order=start_order)
+    doc.apply_stream(ops)
+    return ops, nxt
+
+
+def sim_runs(patches, start_order=0):
+    """(ordp, lenp, next_order) run planes via the kernel-exact host
+    walk (the ``ops.rle.simulate_run_rows`` algebra)."""
+    runs = []
+    next_order = start_order
+    for p in B.merge_patches(patches):
+        if p.del_len:
+            rem, before, i = p.del_len, 0, 0
+            while rem > 0 and i < len(runs):
+                o, l, live = runs[i]
+                lv = l if live else 0
+                cs = min(max(p.pos - before, 0), lv)
+                ce = min(max(p.pos + rem - before, 0), lv)
+                cov = ce - cs
+                if cov > 0:
+                    parts = []
+                    if cs > 0:
+                        parts.append((o, cs, True))
+                    parts.append((o + cs, cov, False))
+                    if ce < l:
+                        parts.append((o + ce, l - ce, True))
+                    runs[i:i + 1] = parts
+                    i += len(parts)
+                    rem -= cov
+                else:
+                    i += 1
+                before += lv - cov
+            next_order += p.del_len
+        il = len(p.ins_content)
+        if il:
+            st = next_order
+            if p.pos == 0:
+                runs.insert(0, (st, il, True))
+            else:
+                before = 0
+                for i, (o, l, live) in enumerate(runs):
+                    lv = l if live else 0
+                    if before + lv >= p.pos:
+                        off = p.pos - before
+                        if off == l and live and st == o + l:
+                            runs[i] = (o, l + il, True)
+                        elif off == lv:
+                            runs.insert(i + 1, (st, il, True))
+                        else:
+                            runs[i:i + 1] = [(o, off, True), (st, il, True),
+                                             (o + off, l - off, True)]
+                        break
+                    before += lv
+            next_order += il
+    ordp = np.asarray([(o + 1) if live else -(o + 1)
+                       for o, _, live in runs], np.int32)
+    lenp = np.asarray([l for _, l, _ in runs], np.int32)
+    return ordp, lenp, next_order
+
+
+def expand(ordp, lenp):
+    if len(ordp) == 0:
+        return np.zeros(0, np.int32)
+    o = ordp.astype(np.int64)
+    ln = lenp.astype(np.int64)
+    base = np.repeat(np.abs(o), ln)
+    within = np.arange(int(ln.sum())) - np.repeat(np.cumsum(ln) - ln, ln)
+    return (np.repeat(np.sign(o), ln) * (base + within)).astype(np.int32)
+
+
+def sim_flat(patches):
+    o, l, _ = sim_runs(patches)
+    return expand(o, l)
+
+
+class TestSpApply:
+    def test_insert_only_prepends_fresh_doc(self):
+        # A fresh sharded doc: every rank lives in shard 0 (no
+        # redistribution); prepend runs must match the simulation.
+        doc = sp_doc(shard_rows=128)
+        patches = [TestPatch(0, 0, "ab")] * 50
+        ops, _ = apply_patches(doc, patches)
+        np.testing.assert_array_equal(doc.expand(), sim_flat(patches))
+        assert doc.to_string([ops]) == expected(patches)
+
+    @pytest.mark.parametrize("seed", [7, 23, 41])
+    def test_loaded_doc_random_stream(self, seed):
+        # The long-context shape: a distributed snapshot (load), then a
+        # random edit stream applied SHARDED; state must equal the
+        # single-walk simulation over the whole history.
+        rng = random.Random(seed)
+        p1, c1 = random_patches(rng, 80)
+        o1, l1, nxt = sim_runs(p1)
+        doc = sp_doc(shard_rows=64)
+        doc.load(o1, l1)
+        np.testing.assert_array_equal(doc.expand(), expand(o1, l1))
+
+        p2 = []
+        content = c1
+        for _ in range(60):
+            if not content or rng.random() < 0.5:
+                pos = rng.randint(0, len(content))
+                ins = "".join(rng.choice("xyz")
+                              for _ in range(rng.randint(1, 3)))
+                p2.append(TestPatch(pos, 0, ins))
+                content = content[:pos] + ins + content[pos:]
+            else:
+                pos = rng.randint(0, len(content) - 1)
+                span = min(rng.randint(1, 3), len(content) - pos)
+                p2.append(TestPatch(pos, span, ""))
+                content = content[:pos] + content[pos + span:]
+        apply_patches(doc, p2, start_order=nxt)
+        np.testing.assert_array_equal(doc.expand(), sim_flat(p1 + p2))
+
+    def test_wide_delete_spans_shards(self):
+        # A loaded doc spread over all 8 shards, then one delete covering
+        # most of it — several shards retire spans in the SAME step.
+        rng = random.Random(3)
+        p1, c1 = random_patches(rng, 80)
+        o1, l1, nxt = sim_runs(p1)
+        assert len(o1) >= 16, "need enough runs to spread"
+        doc = sp_doc(shard_rows=64)
+        doc.load(o1, l1)
+        span = len(c1) - 4
+        p2 = [TestPatch(2, span, ""), TestPatch(1, 0, "Q")]
+        apply_patches(doc, p2, start_order=nxt)
+        np.testing.assert_array_equal(doc.expand(), sim_flat(p1 + p2))
+
+    def test_origins_match_single_device_engine(self):
+        # The discovered origins (the CRDT metadata remote peers need)
+        # must equal ops.rle's for the same second-epoch steps.
+        from text_crdt_rust_tpu.ops import rle as R
+
+        rng = random.Random(9)
+        p1, _ = random_patches(rng, 50)
+        p2, _ = ([], None)
+        o1, l1, nxt = sim_runs(p1)
+        ops1, _ = B.compile_local_patches(B.merge_patches(p1), lmax=8,
+                                          dmax=None)
+        content = expected(p1)
+        p2 = []
+        for _ in range(40):
+            pos = rng.randint(0, len(content))
+            ins = rng.choice(["uv", "w"])
+            p2.append(TestPatch(pos, 0, ins))
+            content = content[:pos] + ins + content[pos:]
+        ops2, _ = B.compile_local_patches(B.merge_patches(p2), lmax=8,
+                                          dmax=None, start_order=nxt)
+
+        doc = sp_doc(shard_rows=64)
+        doc.load(o1, l1)
+        doc.apply_stream(ops2)
+
+        combined = jax.tree.map(
+            lambda a, b: np.concatenate([np.asarray(a), np.asarray(b)]),
+            ops1, ops2)
+        res = R.replay_local_rle(combined, capacity=256, batch=8,
+                                 block_k=8, chunk=128, interpret=True)
+        ol_ref = np.asarray(res.ol)[:, 0]
+        or_ref = np.asarray(res.orr)[:, 0]
+        starts = np.asarray(combined.ins_order_start, np.int64)
+        ilens = np.asarray(combined.ins_len, np.int64)
+        s0 = ops1.num_steps
+        for s in range(s0, combined.num_steps):
+            if ilens[s] > 0:
+                st = int(starts[s])
+                assert doc.ol_log[st] == int(ol_ref[s]), f"step {s}"
+                assert doc.or_log[st] == int(or_ref[s]), f"step {s}"
+
+    def test_capacity_error_raises(self):
+        doc = sp_doc(shard_rows=8)
+        # 50 prepend runs all land in shard 0 (capacity 8) -> must flag.
+        patches = [TestPatch(0, 0, "ab"), TestPatch(0, 0, "xy")] * 25
+        with pytest.raises(RuntimeError, match="capacity"):
+            apply_patches(doc, patches)
+
+    def test_bad_delete_raises(self):
+        doc = sp_doc(shard_rows=32)
+        with pytest.raises(RuntimeError, match="end of the document"):
+            apply_patches(doc, [TestPatch(0, 0, "ab"), TestPatch(0, 5, "")])

