@@ -1,0 +1,99 @@
+"""Compile-only TPU pin of the production launch geometries.
+
+Run on the real chip (no full replay, no timing):
+
+    python perf/compile_pin.py
+
+AOT-compiles (jit .lower().compile(); nothing executes) every geometry
+the committed BENCH_ALL.json depends on — the northstar batch-256 /
+block_k-128 / capacity-32768 shape whose silent regression cost r2 40%
+of its headline, the config-2 shape, the rle-mixed storm shape, and the
+kevin HBM shape.  Exits non-zero naming the first geometry that fails.
+"""
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import numpy as np
+
+from text_crdt_rust_tpu.ops import batch as B
+from text_crdt_rust_tpu.utils.randedit import make_storm
+from text_crdt_rust_tpu.utils.testdata import TestPatch
+
+
+def pin(name, build):
+    t0 = time.time()
+    try:
+        build()
+    except Exception as e:
+        print(f"FAIL {name}: {type(e).__name__}: {e}", flush=True)
+        return False
+    print(f"ok {name} ({time.time() - t0:.1f}s)", flush=True)
+    return True
+
+
+def aot(run_builder):
+    """Build a replayer, then AOT-compile its jitted call."""
+    run = run_builder()
+    # Every make_replayer_* closes over (jitted, staged); reach the pair
+    # through the closure to lower without executing.
+    cells = {v: c.cell_contents for v, c in
+             zip(run.__code__.co_freevars, run.__closure__)}
+    jitted = cells["jitted"]
+    staged = cells.get("staged")
+    tables = cells.get("tables", ())
+    args = tuple(staged) + tuple(tables)
+    jitted.lower(*args).compile()
+
+
+def main():
+    patches = [TestPatch(0, 0, "seed text here")] + [
+        TestPatch(i % 8, 1 if i % 5 == 0 else 0, "ab")
+        for i in range(64)
+    ]
+    merged = B.merge_patches(patches)
+
+    def northstar():
+        from text_crdt_rust_tpu.ops import rle as R
+        ops, _ = B.compile_local_patches(merged, lmax=16, dmax=None)
+        aot(lambda: R.make_replayer_rle(
+            ops, capacity=32768, batch=256, block_k=128, chunk=1024))
+
+    def config2():
+        from text_crdt_rust_tpu.ops import rle as R
+        ops, _ = B.compile_local_patches(merged, lmax=16, dmax=None)
+        aot(lambda: R.make_replayer_rle(
+            ops, capacity=59904, batch=128, block_k=256, chunk=1024))
+
+    def storm():
+        from text_crdt_rust_tpu.ops import rle_mixed as RM
+        txns, _ = make_storm(4, 10, 4, seed=7)
+        table = B.AgentTable(sorted({t.id.agent for t in txns}))
+        ops, _ = B.compile_remote_txns(txns, table, lmax=8, dmax=16)
+        aot(lambda: RM.make_replayer_rle_mixed(
+            ops, capacity=12800, batch=128, block_k=128, chunk=1024))
+
+    def kevin_hbm():
+        from text_crdt_rust_tpu.ops import rle_hbm as RH
+        ops, _ = B.compile_local_patches(
+            [TestPatch(0, 0, " ")] * 64, lmax=1, dmax=None)
+        aot(lambda: RH.make_replayer_rle_hbm(
+            ops, capacity=10506240, batch=64, block_k=512, chunk=1024))
+
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} {dev.device_kind}", flush=True)
+    results = [
+        pin("northstar b256/k128/cap32768", northstar),
+        pin("config2 b128/k256/cap59904", config2),
+        pin("rle-mixed storm b128/k128", storm),
+        pin("kevin rle-hbm b64/k512/cap10.5M", kevin_hbm),
+    ]
+    if not all(results):
+        sys.exit(1)
+    print("all geometries compile", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,105 @@
+"""Sizes VERDICT r3 next #4 (in-kernel run re-merge) on the real traces.
+
+Extends the kernel-exact run simulation with the two candidate in-kernel
+merge rules — insert PREPEND-merge (`mutations.rs:84-109`) and tombstone
+neighbor-merge (`extend_delete`, `root.rs:9-17`) — and measures peak run
+rows.  Result (2026-07-30, full merged streams):
+
+    automerge-paper: base 13218 -> +tomb 12487 (-5.5%); prepend: -0
+    rustcode:        base 14878 -> +tomb 12685 (-14.7%); prepend: -0
+    sveltecomponent: base  7022 -> +tomb  5868 (-16.4%); prepend: -0
+
+The hypothesized ~2x does NOT exist: run merging requires ORDER
+contiguity (the same `can_append` constraint the reference has,
+`span.rs:47-53`), and split-induced neighbors are almost never order-
+contiguous.  The 2.5x capacity budget is block half-fullness after leaf
+splits, which re-merge cannot fix either.  Conclusion: in-kernel
+re-merge is a ~1.06x lever on the north star; not worth kernel risk.
+Run: python perf/merge_sim.py
+"""
+import sys; sys.path.insert(0, ".")
+from text_crdt_rust_tpu.utils.testdata import flatten_patches, load_testing_data, trace_path
+
+def simulate(patches, merge_prepend=False, merge_tomb=False):
+    runs = []  # (order_start, char_len, live)
+    next_order = 0
+    peak = 0
+    def try_merge_at(i):
+        # merge runs[i-1] and runs[i] if order-contiguous same-liveness
+        if not merge_tomb: return
+        if i <= 0 or i >= len(runs): return
+        o1, l1, v1 = runs[i-1]; o2, l2, v2 = runs[i]
+        if v1 == v2 and o1 + l1 == o2:
+            runs[i-1:i+1] = [(o1, l1+l2, v1)]
+    for p in patches:
+        if p.del_len:
+            rem = p.del_len; before = 0; i = 0
+            touched = []
+            while rem > 0 and i < len(runs):
+                o, l, live = runs[i]
+                lv = l if live else 0
+                cs = min(max(p.pos - before, 0), lv)
+                ce = min(max(p.pos + rem - before, 0), lv)
+                cov = ce - cs
+                if cov > 0:
+                    parts = []
+                    if cs > 0: parts.append((o, cs, True))
+                    parts.append((o + cs, cov, False))
+                    if ce < l: parts.append((o + ce, l - ce, True))
+                    runs[i:i+1] = parts
+                    touched.append(i + (1 if cs > 0 else 0))
+                    i += len(parts)
+                    rem -= cov
+                else:
+                    i += 1
+                before += lv - cov
+            # post-delete: merge tombstones with order-contiguous neighbors
+            if merge_tomb:
+                # indices shift as we merge; do a simple local pass around touched
+                j = 0
+                while j < len(runs):
+                    o1, l1, v1 = runs[j]
+                    if j+1 < len(runs):
+                        o2, l2, v2 = runs[j+1]
+                        if v1 == v2 and o1 + l1 == o2:
+                            runs[j:j+2] = [(o1, l1+l2, v1)]
+                            continue
+                    j += 1
+            next_order += p.del_len
+        il = len(p.ins_content)
+        if il:
+            st = next_order
+            if p.pos == 0:
+                if merge_prepend and runs and runs[0][2] and st + il == runs[0][0]:
+                    runs[0] = (st, il + runs[0][1], True)
+                else:
+                    runs.insert(0, (st, il, True))
+            else:
+                before = 0
+                for i, (o, l, live) in enumerate(runs):
+                    lv = l if live else 0
+                    if before + lv >= p.pos:
+                        off = p.pos - before
+                        if off == l and live and st == o + l:
+                            runs[i] = (o, l + il, True)
+                        elif off == lv:
+                            nxt = runs[i+1] if i+1 < len(runs) else None
+                            if merge_prepend and nxt and nxt[2] and st + il == nxt[0]:
+                                runs[i+1] = (st, il + nxt[1], True)
+                            else:
+                                runs.insert(i + 1, (st, il, True))
+                        else:
+                            runs[i:i+1] = [(o, off, True), (st, il, True), (o + off, l - off, True)]
+                        break
+                    before += lv
+            next_order += il
+        peak = max(peak, len(runs))
+    return peak, len(runs)
+
+for trace in ("automerge-paper", "rustcode", "sveltecomponent"):
+    patches = B.merge_patches(flatten_patches(load_testing_data(trace_path(trace))))
+    base = simulate(patches)
+    pm = simulate(patches, merge_prepend=True)
+    tm = simulate(patches, merge_tomb=True)
+    both = simulate(patches, merge_prepend=True, merge_tomb=True)
+    print(f"{trace}: base peak/final {base}, +prepend {pm}, +tomb {tm}, +both {both}")

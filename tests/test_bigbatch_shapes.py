@@ -1,0 +1,58 @@
+"""Pin the 1026x-critical launch geometries (VERDICT r3 next #8).
+
+The northstar row needs batch=256 / block_k=128 to compile and run; r2
+lost 40% of its headline to a silent regression to batch 128.  These
+interpret-mode tests pin the kernel CONSTRUCT mix at the big-batch lane
+counts (reduced capacity — interpreter cost scales with capacity*batch,
+and Mosaic-level compile coverage is ``perf/compile_pin.py``'s job on
+the real chip).
+"""
+import numpy as np
+
+from text_crdt_rust_tpu.ops import batch as B
+from text_crdt_rust_tpu.ops import rle as R
+from text_crdt_rust_tpu.ops import span_arrays as SA
+from text_crdt_rust_tpu.utils.testdata import TestPatch
+
+
+def _patches():
+    # Insert runs, a split, deletes incl. a boundary split — every
+    # kernel path the northstar trace exercises.
+    return [
+        TestPatch(0, 0, "hello world"),
+        TestPatch(5, 0, ", there"),
+        TestPatch(2, 3, "LLO"),
+        TestPatch(0, 1, "H"),
+        TestPatch(4, 6, ""),
+    ]
+
+
+def test_northstar_geometry_256_lanes_interpret():
+    patches = _patches()
+    merged = B.merge_patches(patches)
+    ops, _ = B.compile_local_patches(merged, lmax=16, dmax=None)
+    run = R.make_replayer_rle(ops, capacity=256, batch=256, block_k=128,
+                              chunk=64, interpret=True)
+    res = run()
+    want = ""
+    for p in patches:
+        want = want[:p.pos] + p.ins_content + want[p.pos + p.del_len:]
+    got = SA.to_string(R.rle_to_flat(ops, res))
+    assert got == want
+    # Every lane of the 256 must hold identical state.
+    ordp = np.asarray(res.ordp)
+    assert (ordp == ordp[:, :1]).all()
+
+
+def test_config2_geometry_interpret():
+    # Config 2's shape: block_k 256, batch 128 (the VMEM-bound config).
+    patches = _patches()
+    merged = B.merge_patches(patches)
+    ops, _ = B.compile_local_patches(merged, lmax=16, dmax=None)
+    run = R.make_replayer_rle(ops, capacity=512, batch=128, block_k=256,
+                              chunk=64, interpret=True)
+    got = SA.to_string(R.rle_to_flat(ops, run()))
+    want = ""
+    for p in patches:
+        want = want[:p.pos] + p.ins_content + want[p.pos + p.del_len:]
+    assert got == want
