@@ -1,0 +1,145 @@
+"""Sizes VERDICT r3 next #7 (multi-op steps: W ops from W disjoint blocks).
+
+Simulates the rle engine's block layout (block_k=128, kernel split rule)
+over the merged op streams and greedily packs consecutive ops into
+steps when pairwise slot distance >= 2, no split is pending, and the op
+touches one block.  Result (2026-07-30):
+
+    automerge-paper: 10,712 ops -> 10,243 steps = 1.05 ops/step
+                     (sizes {1: 9815, 2: 391, 3: 33, 4: 4})
+    rustcode:        12,219 ops -> 11,468 steps = 1.07 ops/step
+
+The hypothesized ~3-4x at W=4 does not exist for consecutive-op
+grouping: real typing traces are position-LOCAL, so consecutive merged
+ops almost always hit the same or an adjacent block.  A useful multi-op
+step would need out-of-order scheduling across a lookahead window,
+which changes apply semantics (origins read pre-step state) — rejected.
+Run: python perf/group_sim.py
+"""
+import sys; sys.path.insert(0, ".")
+from text_crdt_rust_tpu.ops import batch as B
+from text_crdt_rust_tpu.utils.testdata import flatten_patches, load_testing_data, trace_path
+
+# Simulate the rle engine's block layout (block_k K, splits at r+2>K keep=r//2)
+# and measure: for consecutive merged ops, how often can W=2,4 ops be grouped
+# into one step (pairwise slot distance >= 2, no split needed, single-block op)?
+def simulate_groups(patches, K=128, W=4):
+    # runs per logical slot: list of lists of (order, len, live)
+    slots = [[]]
+    def live_of(slot): return sum(l for o,l,v in slot if v)
+    def rows_of(slot): return len(slot)
+    next_order = 0
+    # op -> (slot_idx, needs_split, multi_block)
+    infos = []
+    for p in patches:
+        # find slot by live rank
+        def slot_of_rank(p_rank):
+            acc = 0
+            for i, s in enumerate(slots):
+                lv = live_of(s)
+                if acc + lv >= p_rank and (p_rank > acc or i == 0):
+                    return i
+                acc += lv
+            return len(slots) - 1
+        multi = False
+        split = False
+        touched = set()
+        if p.del_len:
+            # walk blocks like the kernel
+            rem = p.del_len
+            guard = 0
+            while rem > 0 and guard < 10000:
+                guard += 1
+                li = slot_of_rank(p.pos + 1)
+                s = slots[li]
+                if rows_of(s) + 2 > K:
+                    split = True
+                    # perform split
+                    keep = len(s)//2
+                    slots[li:li+1] = [s[:keep], s[keep:]]
+                    continue
+                touched.add(li)
+                # apply delete within this block
+                before = sum(live_of(slots[j]) for j in range(li))
+                out = []
+                covered = 0
+                pos_in = before
+                for (o, l, v) in s:
+                    lv = l if v else 0
+                    cs = min(max(p.pos - pos_in, 0), lv)
+                    ce = min(max(p.pos + rem - pos_in, 0), lv)
+                    cov = ce - cs
+                    if cov > 0 and v:
+                        if cs > 0: out.append((o, cs, True))
+                        out.append((o + cs, cov, False))
+                        if ce < l: out.append((o + ce, l - ce, True))
+                        covered += cov
+                    else:
+                        out.append((o, l, v))
+                    pos_in += lv - cov
+                slots[li] = out
+                rem -= covered
+                if covered == 0: break
+            next_order += p.del_len
+            multi = len(touched) > 1
+        il = len(p.ins_content)
+        if il:
+            li = slot_of_rank(p.pos) if p.pos else 0
+            s = slots[li]
+            if rows_of(s) + 2 > K:
+                split = True
+                keep = len(s)//2
+                slots[li:li+1] = [s[:keep], s[keep:]]
+                li = slot_of_rank(p.pos) if p.pos else 0
+                s = slots[li]
+            touched.add(li)
+            # apply insert (simplified: append new run at right place)
+            st = next_order
+            before = sum(live_of(slots[j]) for j in range(li))
+            local = p.pos - before
+            acc = 0
+            done = False
+            for i2, (o, l, v) in enumerate(s):
+                lv = l if v else 0
+                if acc + lv >= local and local > 0:
+                    off = local - acc
+                    if off == l and v and st == o + l:
+                        s[i2] = (o, l + il, True)
+                    elif off == lv:
+                        s.insert(i2 + 1, (st, il, True))
+                    else:
+                        s[i2:i2+1] = [(o, off, True), (st, il, True), (o + off, l - off, True)]
+                    done = True
+                    break
+                acc += lv
+            if not done:
+                s.insert(0, (st, il, True))
+            next_order += il
+        infos.append((min(touched) if touched else 0, split, multi))
+    # grouping: greedy consecutive packing
+    groups = 0
+    i = 0
+    sizes = []
+    n = len(infos)
+    while i < n:
+        cnt = 1
+        used = {infos[i][0]}
+        if not infos[i][1] and not infos[i][2]:
+            j = i + 1
+            while j < n and cnt < W:
+                sl, sp, mu = infos[j]
+                if sp or mu or any(abs(sl - u) < 2 for u in used):
+                    break
+                used.add(sl); cnt += 1; j += 1
+        sizes.append(cnt)
+        groups += 1
+        i += cnt
+    import collections
+    hist = collections.Counter(sizes)
+    total = len(infos)
+    print(f"  ops {total} -> steps {groups} ({total/groups:.2f} ops/step); group sizes {dict(sorted(hist.items()))}")
+
+for trace in ("automerge-paper", "rustcode"):
+    patches = B.merge_patches(flatten_patches(load_testing_data(trace_path(trace))))
+    print(trace)
+    simulate_groups(patches)
