@@ -176,3 +176,35 @@ class TestMixedRleRemote:
         doc = replay_txns(txns, capacity=512, block_k=8, lmax=8)
         assert SA.to_string(doc) == receiver.to_string()
         assert SA.doc_spans(doc) == oracle.doc_spans()
+
+    @pytest.mark.parametrize("seed", [1, 17])
+    def test_n_peer_random_interleavings_converge(self, seed):
+        # SURVEY §4's missing `random_concurrency` test, on the device
+        # engine: N peers editing independently, their txn streams
+        # applied in DIFFERENT causally-valid interleavings, must
+        # converge to one content — and match the oracle under the same
+        # interleaving.
+        rng = random.Random(seed)
+        streams = []
+        for name in ("kim", "lou", "max"):
+            patches, _ = random_patches(rng, 20)
+            streams.append(export_txns_since(
+                oracle_from_patches(patches, agent=name), 0))
+
+        def interleave(order_rng):
+            queues = [list(s) for s in streams]
+            out = []
+            while any(queues):
+                live = [q for q in queues if q]
+                out.append(order_rng.choice(live).pop(0))
+            return out
+
+        results = []
+        for k in range(2):
+            txns = interleave(random.Random(seed * 100 + k))
+            oracle = oracle_txns(txns)
+            doc = replay_txns(txns, capacity=1024, block_k=8)
+            assert SA.to_string(doc) == oracle.to_string()
+            assert SA.doc_spans(doc) == oracle.doc_spans()
+            results.append(SA.to_string(doc))
+        assert results[0] == results[1], "interleavings diverged"
