@@ -126,3 +126,27 @@ class TestMetrics:
         assert s["ops"] == 1000
         assert s["samples"] == 2
         assert meter.ops_per_sec > 0
+
+
+class TestRunStats:
+    def test_run_stats_on_rle_result(self):
+        from text_crdt_rust_tpu.ops import rle as R
+        from text_crdt_rust_tpu.utils.metrics import run_stats
+        from text_crdt_rust_tpu.utils.testdata import TestPatch
+
+        patches = [TestPatch(0, 0, "hello world"), TestPatch(5, 0, ","),
+                   TestPatch(2, 3, "LLO"), TestPatch(0, 1, "H")]
+        merged = B.merge_patches(patches)
+        ops, _ = B.compile_local_patches(merged, lmax=16, dmax=None)
+        res = R.replay_local_rle(ops, capacity=64, batch=8, block_k=8,
+                                 chunk=16, interpret=True)
+        st = run_stats(res)
+        # Cross-check against the expanded per-char state.
+        flat = R.expand_runs(res)
+        assert st["chars"] == len(flat)
+        assert st["live_chars"] == int((flat > 0).sum())
+        assert st["run_rows"] == st["live_rows"] + st["tombstone_rows"]
+        assert st["blocks_used"] >= 1
+        assert 0 < st["block_fill"] <= 1
+        assert st["chars_per_run"] > 1  # runs actually compress
+        assert sum(st["run_histogram"].values()) == st["run_rows"]

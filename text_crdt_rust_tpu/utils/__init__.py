@@ -1,5 +1,6 @@
 from .checkpoint import load_doc, load_flat_doc, save_doc, save_flat_doc
-from .metrics import Throughput, doc_stats, memory_stats, print_stats
+from .metrics import (Throughput, doc_stats, memory_stats,
+                      print_stats, run_stats)
 from .rle import (
     KCRDTSpan,
     KDeleteEntry,
@@ -31,5 +32,6 @@ __all__ = [
     "Throughput",
     "doc_stats",
     "memory_stats",
+    "run_stats",
     "print_stats",
 ]

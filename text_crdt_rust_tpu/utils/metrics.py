@@ -148,6 +148,47 @@ class Throughput:
                 "samples": self.samples}
 
 
+def run_stats(res, doc_index: int = 0) -> dict:
+    """Device RUN-state health metrics for the block engines' results
+    (``RleResult``/``RleMixedResult``) — the `print_stats` family
+    (`root.rs:293-326`) read directly off the run representation:
+
+    - ``run_rows`` / ``live_rows`` / ``tombstone_rows``
+    - ``chars`` / ``live_chars`` and ``chars_per_run`` (the compaction
+      ratio that decides VMEM plane sizes, PERF.md §3)
+    - ``blocks_used`` / ``block_fill`` (occupied rows / (blocks * K) —
+      the leaf-split half-fullness the 2.5x capacity budget covers)
+    - run-length histogram (`split_list/mod.rs:418`'s "compacts to N")
+    """
+    K = res.block_k
+    ordc = np.asarray(res.ordp)[:, doc_index]
+    lenc = np.asarray(res.lenp)[:, doc_index]
+    rows = np.asarray(res.rows)[:, doc_index]
+    nlog = int(np.asarray(res.meta)[0, doc_index])
+    blk = np.asarray(res.blkord)[:, doc_index]
+    o_parts, l_parts = [], []
+    for sl in range(nlog):
+        b, r = int(blk[sl]), int(rows[sl])
+        o_parts.append(ordc[b * K: b * K + r])
+        l_parts.append(lenc[b * K: b * K + r])
+    o = (np.concatenate(o_parts) if o_parts else np.zeros(0, np.int32))
+    ln = (np.concatenate(l_parts) if l_parts else np.zeros(0, np.int32))
+    live = o > 0
+    spans = [(0, 0, 0, int(l if lv else -l)) for l, lv in zip(ln, live)]
+    total_rows = int(len(o))
+    return {
+        "run_rows": total_rows,
+        "live_rows": int(live.sum()),
+        "tombstone_rows": int((~live & (o != 0)).sum()),
+        "chars": int(ln.sum()),
+        "live_chars": int(ln[live].sum()),
+        "chars_per_run": round(float(ln.sum()) / max(total_rows, 1), 2),
+        "blocks_used": nlog,
+        "block_fill": round(total_rows / max(nlog * K, 1), 3),
+        "run_histogram": span_histogram(spans),
+    }
+
+
 def print_stats(doc, detailed: bool = False) -> None:
     """Human-readable dump (`doc.rs:492-498` analog). Downloads a device
     doc once and shares the spans across both stat passes."""
