@@ -1,0 +1,117 @@
+"""Round-4 northstar sweep: lanes x block_k x groups on the rle engine.
+
+Run on the real chip AFTER `bench.py --config all` (one TPU process at a
+time):
+
+    python perf/sweep_r4.py [--quick]
+
+Re-records the round-3 session table that was never captured in an
+artifact (PERF.md §5 provenance caveat) and probes the §6.5 lever
+(smaller planes x more groups).  Writes one JSON row per configuration
+to perf/sweep_r4.json AS EACH COMPLETES (crash-safe, like bench.py's
+RowSink), with oracle verification on every row.
+"""
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import numpy as np
+
+from text_crdt_rust_tpu.ops import batch as B
+from text_crdt_rust_tpu.ops import rle as R
+from text_crdt_rust_tpu.ops import span_arrays as SA
+from text_crdt_rust_tpu.utils.testdata import (
+    flatten_patches,
+    load_testing_data,
+    trace_path,
+)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="3 headline configs only")
+    ap.add_argument("--out", default="perf/sweep_r4.json")
+    args = ap.parse_args()
+
+    data = load_testing_data(trace_path("automerge-paper"))
+    patches = flatten_patches(data)
+    merged = B.merge_patches(patches)
+    lmax = max(len(p.ins_content) for p in merged)
+    ops, _ = B.compile_local_patches(merged, lmax=lmax, dmax=None)
+    n_ops = len(patches)
+    want = data.end_content
+
+    # (batch, block_k, groups); capacity 32768 run rows throughout.
+    configs = [
+        (128, 256, 1),   # committed r3 row (637x) — re-record
+        (256, 128, 1),   # claimed 1026x geometry
+        (384, 256, 1),   # claimed 1035x geometry
+    ]
+    if not args.quick:
+        configs += [
+            (256, 256, 1),
+            (256, 64, 1),
+            (128, 128, 2),   # smaller planes x more groups (PERF §6.5)
+            (128, 64, 4),
+            (256, 128, 4),   # 1024 docs in one launch
+        ]
+
+    rows = []
+
+    def flush():
+        with open(args.out, "w") as f:
+            json.dump(rows, f, indent=1)
+
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} {dev.device_kind}", flush=True)
+    for batch, block_k, groups in configs:
+        tag = f"b{batch}/k{block_k}/g{groups}"
+        try:
+            capacity = ((32768 + block_k - 1) // block_k) * block_k
+            stream = [ops] * groups if groups > 1 else ops
+            run = R.make_replayer_rle(stream, capacity=capacity,
+                                      batch=batch, block_k=block_k,
+                                      chunk=1024)
+            t0 = time.time()
+            res = run()
+            first = (res if groups == 1 else res[0])
+            np.asarray(first.err)
+            compile_s = time.time() - t0
+
+            def batch_wall(n):
+                t0 = time.time()
+                for _ in range(n):
+                    r_ = run()
+                np.asarray((r_ if groups == 1 else r_[0]).err)
+                return time.time() - t0, r_
+
+            t1, _ = batch_wall(2)
+            t2, r_ = batch_wall(6)
+            wall = (t2 - t1) / 4
+            got = SA.to_string(R.rle_to_flat(
+                ops, r_ if groups == 1 else r_[0]))
+            ok = got == want
+            ops_s = n_ops * batch * groups / wall
+            row = {"batch": batch, "block_k": block_k, "groups": groups,
+                   "kernel_wall_s": round(wall, 4),
+                   "ops_per_sec": round(ops_s, 1),
+                   "compile_s": round(compile_s, 1),
+                   "oracle_equal": bool(ok)}
+            print(f"{tag}: {ops_s/1e9:.2f}G ops/s "
+                  f"(wall {wall*1e3:.1f}ms, ok={ok})", flush=True)
+        except Exception as e:
+            row = {"batch": batch, "block_k": block_k, "groups": groups,
+                   "error": f"{type(e).__name__}: {str(e)[:300]}"}
+            print(f"{tag}: FAILED {type(e).__name__}", flush=True)
+        rows.append(row)
+        flush()
+    print(f"wrote {len(rows)} rows to {args.out}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
