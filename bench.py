@@ -286,6 +286,10 @@ def time_run(run, reps):
     # understate it 2-3x. reps < 4 (deliberately slow worst cases, e.g.
     # kevin) skips the fit and reports the conservative RTT-inclusive wall.
     if reps < 4:
+        # Drop the warm-up result BEFORE re-dispatching: at kevin scale
+        # one result set is ~10 GiB of HBM planes, and two live sets
+        # exhaust the chip.
+        del res
         t1, res = batch_wall(reps)
         wall = t1 / reps
         _force(res)
@@ -755,9 +759,13 @@ def cfg_kevin(args):
     worst case (no global rebalance — the round-2 blocker, PERF.md §3).
 
     HBM math at 5M prepends: capacity = 5M * 2.1 (splits leave blocks
-    half full) ~= 10.5M run rows; 2 planes * 10.5M * batch * 4 B = 5.4 GB
-    at batch 64 (+ ~2.6 GB ol/or outputs), which fits the 16 GB chip —
-    batch 128 would not, so the 5M run defaults the lane count to 64."""
+    half full) ~= 10.5M run rows; 2 planes * 10.5M * 128 lanes * 4 B =
+    10.75 GB. The lane dim must be a whole 128-wide tile (Mosaic rejects
+    64-lane HBM-plane slices), so batch stays 128 and the per-op origin
+    outputs — 5.1 GB on their own at this scale — are dropped via
+    ``store_origins=False`` (verification reads final state via
+    ``expand_runs``, which never needs them). block_k=2048 keeps the
+    logical-block tables at ~5k entries instead of 20k."""
     from text_crdt_rust_tpu.ops import rle as R
     from text_crdt_rust_tpu.ops import rle_hbm as RH
 
@@ -783,15 +791,15 @@ def cfg_kevin(args):
     ops, _ = B.compile_local_patches(patches, lmax=1, dmax=None)
     # One run row per prepend (runs cannot merge backwards); splits leave
     # blocks half full, so size ~2.1x rows.
-    block_k = 64 if args.smoke else 512
+    big = n_tpu > 2_000_000
+    block_k = 64 if args.smoke else (2048 if big else 512)
     capacity = ((int(n_tpu * 2.1) + block_k - 1) // block_k) * block_k
-    # 5M rows x batch 128 would blow the 16 GB HBM (see docstring math);
-    # default the full-scale run to 64 lanes.
-    batchk = args.batch or (64 if n_tpu > 2_000_000 else 128)
+    batchk = args.batch or 128
     run = RH.make_replayer_rle_hbm(ops, capacity=capacity,
                                    batch=batchk, block_k=block_k,
                                    chunk=128 if args.smoke else 1024,
-                                   interpret=args.interpret)
+                                   interpret=args.interpret,
+                                   store_origins=not big)
     res, wall, dist = time_run(run, 1)
     flat = R.expand_runs(res)
     got_len = len(flat)

@@ -145,3 +145,28 @@ class TestVsVmemEngine:
                                       np.asarray(res_h.ol))
         np.testing.assert_array_equal(np.asarray(res_v.orr),
                                       np.asarray(res_h.orr))
+
+
+class TestStoreOrigins:
+    """store_origins=False (the kevin-5M memory mode: origin planes are
+    5.1 GB at full scale) must leave final run state bit-identical and
+    only empty out the per-op ``ol``/``orr`` outputs."""
+
+    def test_final_state_identical(self):
+        rng = random.Random(3)
+        patches, content = random_patches(rng, 120)
+        merged = B.merge_patches(patches)
+        lmax = max(len(p.ins_content) for p in merged)
+        ops, _ = B.compile_local_patches(merged, lmax=lmax, dmax=None)
+        kw = dict(capacity=256, batch=4, block_k=32, chunk=16,
+                  interpret=True)
+        full = RH.make_replayer_rle_hbm(ops, **kw)()
+        slim = RH.make_replayer_rle_hbm(ops, store_origins=False, **kw)()
+        full.check()
+        slim.check()
+        assert slim.ol.shape[0] == 0 and slim.orr.shape[0] == 0
+        assert np.array_equal(np.asarray(full.ordp), np.asarray(slim.ordp))
+        assert np.array_equal(np.asarray(full.lenp), np.asarray(slim.lenp))
+        flat_full = R.expand_runs(full)
+        flat_slim = R.expand_runs(slim)
+        assert np.array_equal(flat_full, flat_slim)

@@ -150,3 +150,48 @@ class TestDivergentLanes:
         res = RL.replay_lanes(stacked, capacity=16, chunk=8, interpret=True)
         with pytest.raises(RuntimeError, match="past the end"):
             res.check()
+
+
+class TestLaneTiling:
+    """The lane-block grid dimension (wide batches compile by tiling the
+    lane axis; each lane block runs all chunks before the next starts)
+    must be invisible: tiled and whole-batch replays produce identical
+    state, origins, and flags — including across warm-started chunks."""
+
+    def test_tiled_equals_whole_with_warm_start(self):
+        rng = random.Random(99)
+        nd = 8
+        streams = [random_patches(rng, 40)[0] for _ in range(nd)]
+        stacked, nexts = compile_stack(streams)
+        cap = 256
+        whole = RL.make_replayer_lanes(stacked, capacity=cap, chunk=8,
+                                       interpret=True)()
+        tiled = RL.make_replayer_lanes(stacked, capacity=cap, chunk=8,
+                                       interpret=True, lane_tile=4)()
+        whole.check()
+        tiled.check()
+        for a, b in ((whole.ordp, tiled.ordp), (whole.lenp, tiled.lenp),
+                     (whole.rows, tiled.rows), (whole.ol, tiled.ol),
+                     (whole.orr, tiled.orr)):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+        streams2 = [random_patches(rng, 30)[0] for _ in range(nd)]
+        opses = [B.compile_local_patches(ps, lmax=16, dmax=None,
+                                         start_order=nx)[0]
+                 for ps, nx in zip(streams2, nexts)]
+        stacked2 = B.stack_ops(opses)
+        w2 = RL.make_replayer_lanes(stacked2, capacity=cap, chunk=8,
+                                    interpret=True)(whole.state())
+        t2 = RL.make_replayer_lanes(stacked2, capacity=cap, chunk=8,
+                                    interpret=True, lane_tile=2)(
+                                        tiled.state())
+        w2.check()
+        t2.check()
+        assert np.array_equal(np.asarray(w2.ordp), np.asarray(t2.ordp))
+        assert np.array_equal(np.asarray(w2.lenp), np.asarray(t2.lenp))
+
+    def test_lane_tile_picker(self):
+        assert RL._lane_tile(8) == 8
+        assert RL._lane_tile(512) == 512
+        assert RL._lane_tile(1024) == 512
+        assert RL._lane_tile(2048) == 512

@@ -654,6 +654,12 @@ def rle_to_flat(
     ilens = np.asarray(ops.ins_len, dtype=np.int64)
     ol_np = np.asarray(res.ol)[:, doc_index]
     or_np = np.asarray(res.orr)[:, doc_index]
+    if len(ol_np) < ops.num_steps:
+        raise ValueError(
+            f"rle_to_flat needs per-op origins for all {ops.num_steps} "
+            f"steps but the result carries {len(ol_np)} — was the engine "
+            "built with store_origins=False? (zip truncation would "
+            "silently skip the origin merges)")
     for st, il, left, right in zip(starts, ilens, ol_np, or_np):
         if il > 0:
             ol_log[st] = left

@@ -320,9 +320,19 @@ def make_replayer_rle_hbm(
     block_k: int = 512,
     chunk: int = 1024,
     interpret: bool = False,
+    store_origins: bool = True,
 ):
     """HBM-plane variant of ``rle.make_replayer_rle`` (same contract;
-    ``capacity`` counts RUN rows and may reach millions)."""
+    ``capacity`` counts RUN rows and may reach millions).
+
+    ``store_origins=False`` backs the per-op origin outputs with ONE
+    chunk-sized window instead of the full stream (every chunk
+    overwrites it): at kevin scale (5M steps x 128 lanes) the full
+    ``ol``/``or`` planes alone are 5.1 GB of HBM, which together with
+    the 10.7 GB state planes cannot fit the chip. The returned
+    ``RleResult.ol``/``orr`` are EMPTY in this mode — final state
+    (``expand_runs``) is unaffected, but ``rle_to_flat`` needs origins
+    and must not be fed a store_origins=False result."""
     grouped = isinstance(ops, (list, tuple))
     streams = list(ops) if grouped else [ops]
     G = len(streams)
@@ -366,6 +376,10 @@ def make_replayer_rle_hbm(
     smem = lambda: pl.BlockSpec(
         (chunk,), lambda g, i: (g * blocks_per_g + i,),
         memory_space=pltpu.SMEM)
+    # One reused chunk window when origins aren't kept (see docstring).
+    o_rows = s_pad if store_origins else chunk
+    o_map = (lambda g, i: (g, i, 0)) if store_origins \
+        else (lambda g, i: (g, 0, 0))
 
     call = pl.pallas_call(
         partial(_rle_hbm_kernel, K=block_k, NB=NB, NBL=NBLp, NSUP=NSUP,
@@ -373,9 +387,9 @@ def make_replayer_rle_hbm(
         grid=(G, blocks_per_g),
         in_specs=[smem(), smem(), smem(), smem()],
         out_specs=[
-            pl.BlockSpec((1, chunk, batch), lambda g, i: (g, i, 0),
+            pl.BlockSpec((1, chunk, batch), o_map,
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, chunk, batch), lambda g, i: (g, i, 0),
+            pl.BlockSpec((1, chunk, batch), o_map,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
@@ -389,8 +403,8 @@ def make_replayer_rle_hbm(
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((G, s_pad, batch), jnp.uint32),
-            jax.ShapeDtypeStruct((G, s_pad, batch), jnp.uint32),
+            jax.ShapeDtypeStruct((G, o_rows, batch), jnp.uint32),
+            jax.ShapeDtypeStruct((G, o_rows, batch), jnp.uint32),
             jax.ShapeDtypeStruct((G * capacity, batch), jnp.int32),
             jax.ShapeDtypeStruct((G * capacity, batch), jnp.int32),
             jax.ShapeDtypeStruct((G, NBLp, batch), jnp.int32),
@@ -419,12 +433,18 @@ def make_replayer_rle_hbm(
 
     def run():
         ol, orr, ordp, lenp, blk, rows, meta, err = jitted(*staged)
+        # G == 1: hand the planes over as-is — a [0:capacity] slice is a
+        # device COPY, and at kevin scale that transient doubles a 5 GiB
+        # plane and OOMs the chip.
         results = [
             RleResult(
-                ordp=ordp[gi * capacity:(gi + 1) * capacity],
-                lenp=lenp[gi * capacity:(gi + 1) * capacity],
+                ordp=ordp if G == 1 else
+                ordp[gi * capacity:(gi + 1) * capacity],
+                lenp=lenp if G == 1 else
+                lenp[gi * capacity:(gi + 1) * capacity],
                 blkord=blk[gi], rows=rows[gi], meta=meta[gi],
-                ol=ol[gi, :lens[gi]], orr=orr[gi, :lens[gi]], err=err,
+                ol=ol[gi, :lens[gi] if store_origins else 0],
+                orr=orr[gi, :lens[gi] if store_origins else 0], err=err,
                 block_k=block_k, num_blocks=NB, batch=batch)
             for gi in range(G)
         ]

@@ -79,8 +79,12 @@ def _rle_lanes_kernel(
     *, CAP: int, CHUNK: int,
 ):
     B = ordp.shape[1]
-    i = pl.program_id(0)
-    last = pl.num_programs(0) - 1
+    # Grid = (lane blocks, chunks): lanes are independent documents, so
+    # wide batches tile the lane axis (a 2048-lane whole-array kernel
+    # spills ~105MB of registers and fails to compile); each lane block
+    # runs ALL its chunks before the next block starts, preserving the
+    # chunk-sequential state contract per lane.
+    i = pl.program_id(1)
     idx = lax.broadcasted_iota(jnp.int32, (CAP, B), 0)
     root_u = jnp.uint32(ROOT_ORDER)
 
@@ -238,7 +242,6 @@ def _rle_lanes_kernel(
         return 0
 
     lax.fori_loop(0, CHUNK, op_body, 0)
-    del last
 
 
 @dataclasses.dataclass
@@ -270,20 +273,36 @@ class LanesResult:
         return self.ordp, self.lenp, self.rows
 
 
+def _lane_tile(B: int) -> int:
+    """Largest lane-block width <= 512 dividing B (full B when small).
+
+    512 lanes x ~1.7k-run planes keeps every per-op temporary a few MB;
+    the whole-B alternative spills registers past the VMEM budget at
+    2048 lanes (the round-3 config-5 compile failure)."""
+    if B <= 512:
+        return B
+    for t in (512, 384, 256, 128):
+        if B % t == 0:
+            return t
+    return B  # odd widths: no tiling (small-B test shapes)
+
+
 @functools.lru_cache(maxsize=32)
 def _build_call(s_pad: int, B: int, capacity: int, chunk: int,
-                interpret: bool):
+                interpret: bool, lane_tile: int | None = None):
     """Shape-keyed cache: streaming chunks share one compiled kernel
     (a per-chunk pallas_call would re-trace and re-compile ~5-30s each —
     the whole point of warm starts is that chunk N+1 is cheap)."""
-    col = lambda: pl.BlockSpec((chunk, B), lambda i: (i, 0),
+    T = lane_tile or _lane_tile(B)
+    _require(B % T == 0, f"lane_tile {T} must divide batch {B}")
+    col = lambda: pl.BlockSpec((chunk, T), lambda lb, i: (i, lb),
                                memory_space=pltpu.VMEM)
     whole = lambda shape: pl.BlockSpec(
-        shape, lambda i: tuple(0 for _ in shape), memory_space=pltpu.VMEM)
+        (shape[0], T), lambda lb, i: (0, lb), memory_space=pltpu.VMEM)
 
     call = pl.pallas_call(
         partial(_rle_lanes_kernel, CAP=capacity, CHUNK=chunk),
-        grid=(s_pad // chunk,),
+        grid=(B // T, s_pad // chunk),
         in_specs=[col(), col(), col(), col(),
                   whole((capacity, B)), whole((capacity, B)),
                   whole((1, B))],
@@ -314,6 +333,7 @@ def make_replayer_lanes(
     chunk: int = 128,
     init=None,
     interpret: bool = False,
+    lane_tile: int | None = None,
 ):
     """Build a jitted per-lane replayer for a BATCHED op stream
     (``stack_ops`` output: every column [S, B]).
@@ -352,7 +372,7 @@ def make_replayer_lanes(
         init = (jnp.asarray(o0, jnp.int32), jnp.asarray(l0, jnp.int32),
                 jnp.asarray(r0, jnp.int32).reshape(1, B))
 
-    jitted = _build_call(s_pad, B, capacity, chunk, interpret)
+    jitted = _build_call(s_pad, B, capacity, chunk, interpret, lane_tile)
 
     def run(state=None) -> LanesResult:
         ini = init if state is None else (
