@@ -273,7 +273,14 @@ def time_run(run, reps):
 
     def batch_wall(n):
         t0 = time.perf_counter()
+        res = None
         for _ in range(n):
+            # Drop the previous dispatch's result reference before
+            # enqueuing the next: dispatches stay pipelined (the runtime
+            # holds buffers until each completes), but Python no longer
+            # pins N result sets live — at kevin scale one set is
+            # ~10 GiB and two pinned sets exhaust HBM.
+            del res
             res = run()
         sync(res)
         return time.perf_counter() - t0, res

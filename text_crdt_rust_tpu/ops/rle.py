@@ -185,7 +185,7 @@ def _rle_kernel(
                                                 #   blocks used as working
                                                 #   state — halves VMEM)
     blk_out, rows_out, meta_out, err_ref,       # tables + flags
-    blkord, rws, liv, meta,                     # persistent scratch
+    blkord, rws, liv, cumliv, meta,             # persistent scratch
     *, K: int, NB: int, NBL: int, CHUNK: int,
 ):
     B = ordp.shape[1]
@@ -211,20 +211,26 @@ def _rle_kernel(
         blkord[:] = jnp.zeros_like(blkord)
         rws[:] = jnp.zeros_like(rws)
         liv[:] = jnp.zeros_like(liv)
+        cumliv[:] = jnp.zeros_like(cumliv)
         meta[0] = 1  # blocks in use (logical slots == physical blocks)
 
     def slot_scalar(tbl, l):
         return _lane_scalar(jnp.where(idx_l == l, tbl[:], 0))
 
     def live_before_slot(l):
-        return _lane_scalar(jnp.where(idx_l < l, liv[:], 0))
+        return slot_scalar(cumliv, l) - slot_scalar(liv, l)
 
     def slot_of_live_rank(rank1):
         """Smallest logical slot whose cumulative live-char count reaches
-        ``rank1`` (the B-tree descent `root.rs:54-88` over block sums)."""
+        ``rank1`` (the B-tree descent `root.rs:54-88` over block sums).
+
+        ``cumliv`` is the inclusive live prefix per slot, maintained
+        INCREMENTALLY (one masked add per op; splits shift it with the
+        other tables) instead of recomputed by an 8-roll cumsum on every
+        descent — the remaining sequencing-cost lever PERF.md §6 named.
+        Slots >= nlog may hold stale values; the mask excludes them."""
         nlog = meta[0]
-        cum = _cumsum_rows(jnp.where(idx_l < nlog, liv[:], 0))
-        hit = (cum < rank1) & (idx_l < nlog)
+        hit = (cumliv[:] < rank1) & (idx_l < nlog)
         return jnp.minimum(
             jnp.max(jnp.sum(hit.astype(jnp.int32), axis=0)), nlog - 1)
 
@@ -266,11 +272,16 @@ def _rle_kernel(
             lenp[pl.ds(b * K, K), :] = jnp.where(keep_mask, bl, 0)
 
             # Splice the new block into the logical order at slot l+1.
-            for tbl in (blkord, rws, liv):
+            # cumliv shifts with the tables: slots > l take the old
+            # predecessor prefix (slot l+1 inherits old c_l, which IS
+            # its inclusive prefix after the split); slot l's inclusive
+            # prefix loses the moved-out top half.
+            for tbl in (blkord, rws, liv, cumliv):
                 shifted = _shift_rows(tbl[:], 1, 1)
                 tbl[:] = jnp.where(idx_l <= l, tbl[:], shifted)
             rws[pl.ds(l, 1), :] = jnp.broadcast_to(keep, (1, B))
             liv[pl.ds(l, 1), :] = jnp.broadcast_to(liv_lo, (1, B))
+            cumliv[pl.ds(l, 1), :] = cumliv[pl.ds(l, 1), :] - liv_hi
             blkord[pl.ds(l + 1, 1), :] = jnp.broadcast_to(nb, (1, B))
             rws[pl.ds(l + 1, 1), :] = jnp.broadcast_to(mv, (1, B))
             liv[pl.ds(l + 1, 1), :] = jnp.broadcast_to(liv_hi, (1, B))
@@ -322,6 +333,7 @@ def _rle_kernel(
         lenp[pl.ds(b * K, K), :] = nl
         rws[pl.ds(l, 1), :] = rws[pl.ds(l, 1), :] + amt
         liv[pl.ds(l, 1), :] = liv[pl.ds(l, 1), :] + il
+        cumliv[:] = jnp.where(idx_l >= l, cumliv[:] + il, cumliv[:])
 
         ol_ref[:, pl.ds(k, 1), :] = jnp.broadcast_to(left, (1, 1, B))
         or_ref[:, pl.ds(k, 1), :] = jnp.broadcast_to(right, (1, 1, B))
@@ -350,6 +362,7 @@ def _rle_kernel(
             lenp[pl.ds(b * K, K), :] = nl
             rws[pl.ds(l, 1), :] = rws[pl.ds(l, 1), :] + added
             liv[pl.ds(l, 1), :] = liv[pl.ds(l, 1), :] - tot
+            cumliv[:] = jnp.where(idx_l >= l, cumliv[:] - tot, cumliv[:])
             return rem - tot, iters + 1
 
         # Each iteration clears one block's covered span; > 2*NBL
@@ -512,6 +525,7 @@ def make_replayer_rle(
             pltpu.VMEM((NBLp, batch), jnp.int32),       # blkord
             pltpu.VMEM((NBLp, batch), jnp.int32),       # rws
             pltpu.VMEM((NBLp, batch), jnp.int32),       # liv
+            pltpu.VMEM((NBLp, batch), jnp.int32),       # cumliv
             pltpu.SMEM((2,), jnp.int32),                # meta
         ],
         compiler_params=pltpu.CompilerParams(
