@@ -306,6 +306,7 @@ def time_run(run, reps):
         }
     n1 = max(2, reps // 4)
     n2 = max(n1 + 4, reps)
+    del res  # same two-live-result-sets hazard as the reps < 4 branch
     t1, _ = batch_wall(n1)
     t2, res = batch_wall(n2)
     wall = (t2 - t1) / (n2 - n1)

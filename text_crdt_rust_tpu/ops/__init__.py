@@ -33,4 +33,9 @@ see ``span_arrays``) and cross-check bit-identically in ``tests/``:
 host-side analog of the reference's bench replay loop,
 `benches/yjs.rs:32-49`), RLE-merges patch streams, and owns the agent
 name-rank table incl. cross-epoch onboarding (``rank_remap``).
+
+``stream_scan`` is the >HBM read path: host-resident run planes of any
+length, scanned tile-by-tile with host-carried prefixes (SURVEY §5's
+"block-wise scans for >HBM documents"; mutation at that scale goes
+through ``rle_hbm`` or ``parallel.sp_apply``).
 """
