@@ -307,22 +307,29 @@ def time_run(run, reps):
     n1 = max(2, reps // 4)
     n2 = max(n1 + 4, reps)
     del res  # same two-live-result-sets hazard as the reps < 4 branch
-    t1, _ = batch_wall(n1)
+    t1, res = batch_wall(n1)
+    del res  # and again between the two fit points
     t2, res = batch_wall(n2)
     wall = (t2 - t1) / (n2 - n1)
     if wall <= 0:  # timing noise swamped the fit; fall back (conservative)
         wall = t2 / n2
-    # Latency: ONE dispatch + hard sync, labeled as including the host
-    # round-trip (the number a caller awaiting a single batch observes).
-    t0 = time.perf_counter()
-    res = run()
-    sync(res)
-    blocking = time.perf_counter() - t0
+    # Latency: blocking dispatch + hard sync, labeled as including the
+    # host round-trip (the number a caller awaiting a single batch
+    # observes). 5 samples -> p50, the BASELINE.json latency metric.
+    samples = []
+    for _ in range(5):
+        del res
+        t0 = time.perf_counter()
+        res = run()
+        sync(res)
+        samples.append(time.perf_counter() - t0)
     _force(res)
     dist = {
         "slope_fit_runs": [n1, n2],
         "host_overhead_ms": round((t1 - n1 * wall) * 1e3, 3),
-        "blocking_run_ms_incl_host_rtt": round(blocking * 1e3, 3),
+        "blocking_run_ms_incl_host_rtt": round(samples[0] * 1e3, 3),
+        "p50_blocking_run_ms_incl_host_rtt": round(
+            sorted(samples)[len(samples) // 2] * 1e3, 3),
     }
     return res, wall, dist
 

@@ -2,11 +2,12 @@
 # Round-4 tunnel-recovery watcher: wait for the TPU to come back, then
 # (1) drop the northstar row so it re-records on the incremental-descent
 # kernel, (2) run the suite with --resume (configs 1-5 keep their clean
-# rows; northstar + kevin run fresh). Safe to re-run; BENCH_ALL.json is
-# backed up first.
-set -u
+# rows; northstar + kevin run fresh). Safe to re-run: the backup is
+# taken once (cp -n) and any failure before the bench aborts the script
+# instead of silently resuming past a stale row.
+set -eu
 cd /root/repo
-cp BENCH_ALL.json perf/BENCH_ALL_pre_kevin.json 2>/dev/null || true
+cp -n BENCH_ALL.json perf/BENCH_ALL_pre_kevin.json 2>/dev/null || true
 while true; do
   if timeout 240 python -c "
 import jax, numpy as np, jax.numpy as jnp

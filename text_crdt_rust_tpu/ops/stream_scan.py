@@ -32,13 +32,6 @@ from .blocked import _require
 
 
 @jax.jit
-def _tile_live(o, l):
-    """Total live chars in one tile (i32: each tile's live total must
-    fit; the CROSS-tile total rides host-side in int64)."""
-    return jnp.sum(jnp.where(o > 0, l, 0))
-
-
-@jax.jit
 def _tile_rank(o, l, rank1):
     """Resolve 1-based live rank ``rank1`` (known to land in this tile,
     so tile-local arithmetic fits i32) -> (tile-local row, 1-based
@@ -92,29 +85,44 @@ class StreamedRuns:
         # Carry table: live chars BEFORE each tile (the host-side analog
         # of sp_runs' all-gathered shard totals) + per-tile order bounds
         # so order lookups skip tiles that cannot contain the order.
-        totals = []
+        # All computed HOST-side in int64 (no device round-trips, and the
+        # device in-tile cumsums are i32, so each tile's live total is
+        # required to fit i32 — shrink ``tile`` otherwise).
+        totals = np.empty(self.ntiles, np.int64)
         self.omin = np.empty(self.ntiles, np.int64)
         self.omax = np.empty(self.ntiles, np.int64)
         for t in range(self.ntiles):
-            o, l = self._tile(t)
-            totals.append(int(_tile_live(o, l)))
-            occ = np.abs(np.asarray(o, np.int64))
-            ln = np.asarray(l, np.int64)
+            s = t * self.tile
+            o = np.asarray(self.ordp[s:s + self.tile], np.int64)
+            l = np.asarray(self.lenp[s:s + self.tile], np.int64)
+            totals[t] = int(np.where(o > 0, l, 0).sum())
+            _require(totals[t] < 2 ** 31,
+                     f"tile {t} live total {totals[t]} overflows the "
+                     "i32 in-tile cumsum; use a smaller tile")
+            occ = np.abs(o)
             mask = occ > 0
             self.omin[t] = (occ[mask] - 1).min() if mask.any() else -1
-            self.omax[t] = (occ[mask] - 1 + ln[mask]).max() \
+            self.omax[t] = (occ[mask] - 1 + l[mask]).max() \
                 if mask.any() else -1
         self.carry = np.concatenate(([0], np.cumsum(totals)))
+        self._cached_t = -1
+        self._cached = None
 
     def _tile(self, t: int):
-        s = t * self.tile
-        o = np.asarray(self.ordp[s:s + self.tile], np.int32)
-        l = np.asarray(self.lenp[s:s + self.tile], np.int32)
-        if len(o) < self.tile:  # final partial tile only
-            pad = self.tile - len(o)
-            o = np.pad(o, (0, pad))
-            l = np.pad(l, (0, pad))
-        return jnp.asarray(o), jnp.asarray(l)
+        # One-entry upload cache: repeated lookups overwhelmingly hit
+        # the same tile, and a fresh H2D transfer per call would cost
+        # tile * 8 bytes each time.
+        if self._cached_t != t:
+            s = t * self.tile
+            o = np.asarray(self.ordp[s:s + self.tile], np.int32)
+            l = np.asarray(self.lenp[s:s + self.tile], np.int32)
+            if len(o) < self.tile:  # final partial tile only
+                pad = self.tile - len(o)
+                o = np.pad(o, (0, pad))
+                l = np.pad(l, (0, pad))
+            self._cached = (jnp.asarray(o), jnp.asarray(l))
+            self._cached_t = t
+        return self._cached
 
     def live_total(self) -> int:
         return int(self.carry[-1])
