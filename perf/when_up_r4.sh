@@ -7,7 +7,10 @@
 # instead of silently resuming past a stale row.
 set -eu
 cd /root/repo
-cp -n BENCH_ALL.json perf/BENCH_ALL_pre_kevin.json 2>/dev/null || true
+# Back up once; a REAL copy failure must abort (set -e), while
+# "already backed up" / "nothing to back up" skip explicitly.
+[ ! -f BENCH_ALL.json ] || [ -e perf/BENCH_ALL_pre_kevin.json ] || \
+  cp BENCH_ALL.json perf/BENCH_ALL_pre_kevin.json
 while true; do
   if timeout 240 python -c "
 import jax, numpy as np, jax.numpy as jnp

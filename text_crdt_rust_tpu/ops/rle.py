@@ -621,11 +621,14 @@ def expand_runs(res: RleResult, doc_index: int = 0) -> np.ndarray:
     ``FlatDoc.signed`` layout), host-side numpy."""
     res.check()
     K = res.block_k
-    ordc = np.asarray(res.ordp)[:, doc_index]
-    lenc = np.asarray(res.lenp)[:, doc_index]
-    blk = np.asarray(res.blkord)[:, doc_index]
-    rows = np.asarray(res.rows)[:, doc_index]
-    nlog = int(np.asarray(res.meta)[0, doc_index])
+    # Slice the lane ON DEVICE before downloading: np.asarray on the
+    # whole plane would pull capacity x batch through the host link
+    # (10.7 GB at kevin-5M scale) for one lane's worth of data.
+    ordc = np.asarray(res.ordp[:, doc_index])
+    lenc = np.asarray(res.lenp[:, doc_index])
+    blk = np.asarray(res.blkord[:, doc_index])
+    rows = np.asarray(res.rows[:, doc_index])
+    nlog = int(np.asarray(res.meta[0, doc_index]))
     o_parts, l_parts = [], []
     for l in range(nlog):
         b, r = int(blk[l]), int(rows[l])
