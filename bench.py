@@ -131,6 +131,8 @@ class RowSink:
         self.kept = []  # prior rows of OTHER variants: preserved on
         #                 flush (resuming with different flags must not
         #                 erase the results it can't reuse)
+        self.pending = {}  # cfg_key -> superseded same-variant rows,
+        #                    dropped only when the key re-records
         self.done_keys = set()
         if resume and os.path.exists(path):
             with open(path) as f:
@@ -145,22 +147,37 @@ class RowSink:
                     self.rows.extend(rows)
                     self.done_keys.add(key)
                 else:
-                    self.kept.extend(rows)
+                    # Preserve rows this resume can't regenerate (other
+                    # variants) unconditionally. Same-variant error/
+                    # mixed rows are SUPERSEDED by the re-run, but only
+                    # once it actually happens: they stay in the file
+                    # (via ``pending``) until add() records their key,
+                    # so a crash before that point loses nothing.
+                    self.kept.extend(r for r in rows
+                                     if r.get("variant") != variant)
+                    same = [r for r in rows
+                            if r.get("variant") == variant]
+                    if key and same:
+                        self.pending[key] = same
             log(f"resume: {len(self.done_keys)} configs already recorded "
                 f"clean in {path}: {sorted(self.done_keys)}; "
-                f"{len(self.kept)} other-variant/error rows preserved")
+                f"{len(self.kept)} other-variant rows preserved; "
+                f"{len(self.pending)} same-variant error/mixed configs "
+                f"scheduled for re-run (their old rows kept until then)")
 
     def add(self, key: str, out):
         for row in (out if isinstance(out, list) else [out]):
             row["cfg_key"] = key
             row["variant"] = self.variant
             self.rows.append(row)
+        self.pending.pop(key, None)  # the re-run supersedes them now
         self.flush()
 
     def flush(self):
         tmp = self.path + ".tmp"
+        stale = [r for rows in self.pending.values() for r in rows]
         with open(tmp, "w") as f:
-            json.dump(self.rows + self.kept, f, indent=1)
+            json.dump(self.rows + self.kept + stale, f, indent=1)
         os.replace(tmp, self.path)
 
 
