@@ -134,8 +134,9 @@ def _mixed_rle_kernel(
     ordp, lenp,                                 # [CAP, B] run planes (OUT
                                                 #   blocks as working state)
     blk_out, rows_out, meta_out, err_ref,       # tables + flags
-    blkord, rws, liv, raw, ordblk, oll, orl,    # VMEM scratch
-    meta,                                       # SMEM scratch
+    blkord, rws, liv, raw, cumliv, cumraw,      # VMEM scratch (cum* =
+    ordblk, oll, orl,                           #   incremental inclusive
+    meta,                                       #   prefixes; SMEM scratch
     *, K: int, NB: int, NBL: int, CHUNK: int, OT: int, DMAX: int,
 ):
     B = ordp.shape[1]
@@ -162,6 +163,8 @@ def _mixed_rle_kernel(
         rws[:] = jnp.zeros_like(rws)
         liv[:] = jnp.zeros_like(liv)
         raw[:] = jnp.zeros_like(raw)
+        cumliv[:] = jnp.zeros_like(cumliv)
+        cumraw[:] = jnp.zeros_like(cumraw)
         ordblk[:] = jnp.zeros_like(ordblk)
         err_ref[:] = jnp.zeros_like(err_ref)
         oll[:] = oll_in[:]
@@ -192,16 +195,33 @@ def _mixed_rle_kernel(
     def slot_scalar(tbl, l):
         return _lane_scalar(jnp.where(idx_l == l, tbl[:], 0))
 
-    def sum_before_slot(tbl, l):
+    # Descents take a (table, inclusive-prefix) pair; the prefixes are
+    # maintained INCREMENTALLY (one masked add per update; splits shift
+    # them with the other tables) instead of an 8-roll cumsum per
+    # lookup — this kernel descends up to 3x per YATA while-iteration,
+    # so the recompute dominated the storm's step cost.
+    LIV = (liv, cumliv)
+    RAW = (raw, cumraw)
+
+    def sum_before_slot(tblcum, l):
+        # One masked reduction; the incremental prefix is only needed
+        # by slot_of_cum/total_of (review: cum[l] - tbl[l] would be two
+        # lane reductions for the same answer).
+        tbl, _ = tblcum
         return _lane_scalar(jnp.where(idx_l < l, tbl[:], 0))
 
-    def slot_of_cum(tbl, rank1):
-        """Smallest logical slot whose cumulative ``tbl`` count reaches
-        ``rank1`` (the `root.rs:54-88` descent over block sums; ``tbl`` =
-        liv for content cursors, raw for raw cursors — `index.rs:100`)."""
+    def total_of(tblcum):
+        _, cum = tblcum
+        return slot_scalar(cum, meta[0] - 1)
+
+    def slot_of_cum(tblcum, rank1):
+        """Smallest logical slot whose cumulative count reaches
+        ``rank1`` (the `root.rs:54-88` descent over block sums; LIV for
+        content cursors, RAW for raw cursors — `index.rs:100`). Slots
+        >= nlog may hold stale prefixes; the mask excludes them."""
+        _, cum = tblcum
         nlog = meta[0]
-        cum = _cumsum_rows(jnp.where(idx_l < nlog, tbl[:], 0))
-        hit = (cum < rank1) & (idx_l < nlog)
+        hit = (cum[:] < rank1) & (idx_l < nlog)
         return jnp.minimum(
             jnp.max(jnp.sum(hit.astype(jnp.int32), axis=0)), nlog - 1)
 
@@ -247,12 +267,17 @@ def _mixed_rle_kernel(
             ordp[pl.ds(b * K, K), :] = jnp.where(keep_mask, bo, 0)
             lenp[pl.ds(b * K, K), :] = jnp.where(keep_mask, bl, 0)
 
-            for tbl in (blkord, rws, liv, raw):
+            # cum prefixes shift with the tables; slot l+1 inherits the
+            # old inclusive prefix of l (correct), slot l loses the
+            # moved-out top half (see ops.rle split).
+            for tbl in (blkord, rws, liv, raw, cumliv, cumraw):
                 shifted = _shift_rows(tbl[:], 1, 1)
                 tbl[:] = jnp.where(idx_l <= l, tbl[:], shifted)
             rws[pl.ds(l, 1), :] = jnp.broadcast_to(keep, (1, B))
             liv[pl.ds(l, 1), :] = jnp.broadcast_to(liv_lo, (1, B))
             raw[pl.ds(l, 1), :] = jnp.broadcast_to(raw_lo, (1, B))
+            cumliv[pl.ds(l, 1), :] = cumliv[pl.ds(l, 1), :] - liv_hi
+            cumraw[pl.ds(l, 1), :] = cumraw[pl.ds(l, 1), :] - raw_hi
             blkord[pl.ds(l + 1, 1), :] = jnp.broadcast_to(nb, (1, B))
             rws[pl.ds(l + 1, 1), :] = jnp.broadcast_to(mv, (1, B))
             liv[pl.ds(l + 1, 1), :] = jnp.broadcast_to(liv_hi, (1, B))
@@ -313,7 +338,7 @@ def _mixed_rle_kernel(
         bl = lenp[pl.ds(b * K, K), :]
         raw_before = _lane_scalar(jnp.where(idx_k < row, bl, 0))
         so_row = jnp.abs(_row_scalar(bo, row, idx_k)) - 1
-        return sum_before_slot(raw, l) + raw_before + (o - so_row)
+        return sum_before_slot(RAW, l) + raw_before + (o - so_row)
 
     def cursor_after(o):
         return jnp.where(o == root_i, 0, pos_of_order(o) + 1)
@@ -321,10 +346,10 @@ def _mixed_rle_kernel(
     def run_at_raw(c):
         """Signed start order, length, and 0-based char offset of the run
         holding RAW position ``c``."""
-        l = slot_of_cum(raw, c + 1)
+        l = slot_of_cum(RAW, c + 1)
         b = slot_scalar(blkord, l)
         r0 = slot_scalar(rws, l)
-        local = c - sum_before_slot(raw, l)
+        local = c - sum_before_slot(RAW, l)
         bo = ordp[pl.ds(b * K, K), :]
         bl = lenp[pl.ds(b * K, K), :]
         cum = _cumsum_rows(bl)
@@ -338,7 +363,7 @@ def _mixed_rle_kernel(
     # ---- local ops (the ops.rle paths + raw/index/table upkeep) ---------
 
     def find_insert_slot(p):
-        l = jnp.where(p == 0, 0, slot_of_cum(liv, p))
+        l = jnp.where(p == 0, 0, slot_of_cum(LIV, p))
         return l, slot_scalar(rws, l)
 
     def record_insert(k, b, st, il, left, right):
@@ -363,7 +388,7 @@ def _mixed_rle_kernel(
 
         l, r0 = find_insert_slot(p)
         b = slot_scalar(blkord, l)
-        base = sum_before_slot(liv, l)
+        base = sum_before_slot(LIV, l)
         local = p - base
         bo = ordp[pl.ds(b * K, K), :]
         bl = lenp[pl.ds(b * K, K), :]
@@ -395,6 +420,8 @@ def _mixed_rle_kernel(
         rws[pl.ds(l, 1), :] = rws[pl.ds(l, 1), :] + amt
         liv[pl.ds(l, 1), :] = liv[pl.ds(l, 1), :] + il
         raw[pl.ds(l, 1), :] = raw[pl.ds(l, 1), :] + il
+        cumliv[:] = jnp.where(idx_l >= l, cumliv[:] + il, cumliv[:])
+        cumraw[:] = jnp.where(idx_l >= l, cumraw[:] + il, cumraw[:])
         record_insert(k, b, st, il, left, right)
 
     def do_local_delete(p, d):
@@ -403,15 +430,15 @@ def _mixed_rle_kernel(
 
         def body(carry):
             rem, iters = carry
-            l = slot_of_cum(liv, p + 1)
+            l = slot_of_cum(LIV, p + 1)
 
             @pl.when(slot_scalar(rws, l) + 2 > K)
             def _():
                 split(l)
 
-            l = slot_of_cum(liv, p + 1)
+            l = slot_of_cum(LIV, p + 1)
             b = slot_scalar(blkord, l)
-            base = sum_before_slot(liv, l)
+            base = sum_before_slot(LIV, l)
             bo = ordp[pl.ds(b * K, K), :]
             bl = lenp[pl.ds(b * K, K), :]
             no, nl, added, tot = _delete_block_math(
@@ -420,6 +447,7 @@ def _mixed_rle_kernel(
             lenp[pl.ds(b * K, K), :] = nl
             rws[pl.ds(l, 1), :] = rws[pl.ds(l, 1), :] + added
             liv[pl.ds(l, 1), :] = liv[pl.ds(l, 1), :] - tot
+            cumliv[:] = jnp.where(idx_l >= l, cumliv[:] - tot, cumliv[:])
             return rem - tot, iters + 1
 
         rem, _ = lax.while_loop(
@@ -440,7 +468,7 @@ def _mixed_rle_kernel(
         Pinned-scan_start rule (tests/test_integrate_divergence.py)."""
         cursor0 = cursor_after(o_left)
         left_cursor = cursor0
-        n = sum_before_slot(raw, meta[0])
+        n = total_of(RAW)
 
         def cond(state):
             cursor, scanning, scan_start, done = state
@@ -482,16 +510,16 @@ def _mixed_rle_kernel(
 
     def do_remote_insert(k, my_rank, o_left, o_right, il, st):
         c = integrate_cursor(my_rank, o_left, o_right)
-        l = jnp.where(c == 0, 0, slot_of_cum(raw, c))
+        l = jnp.where(c == 0, 0, slot_of_cum(RAW, c))
 
         @pl.when(slot_scalar(rws, l) + 2 > K)
         def _():
             split(l)
 
-        l = jnp.where(c == 0, 0, slot_of_cum(raw, c))
+        l = jnp.where(c == 0, 0, slot_of_cum(RAW, c))
         b = slot_scalar(blkord, l)
         r0 = slot_scalar(rws, l)
-        local = c - sum_before_slot(raw, l)
+        local = c - sum_before_slot(RAW, l)
         bo = ordp[pl.ds(b * K, K), :]
         bl = lenp[pl.ds(b * K, K), :]
         i_r, o_r, l_r, off = _locate_run_raw(bo, bl, idx_k, r0, local)
@@ -502,6 +530,8 @@ def _mixed_rle_kernel(
         rws[pl.ds(l, 1), :] = rws[pl.ds(l, 1), :] + amt
         liv[pl.ds(l, 1), :] = liv[pl.ds(l, 1), :] + il
         raw[pl.ds(l, 1), :] = raw[pl.ds(l, 1), :] + il
+        cumliv[:] = jnp.where(idx_l >= l, cumliv[:] + il, cumliv[:])
+        cumraw[:] = jnp.where(idx_l >= l, cumraw[:] + il, cumraw[:])
         record_insert(k, b, st, il, o_left, o_right)
 
     # ---- remote delete (`doc.rs:295-340`) -------------------------------
@@ -571,6 +601,8 @@ def _mixed_rle_kernel(
                 lenp[pl.ds(b * K, K), :] = nl
                 rws[pl.ds(l, 1), :] = rws[pl.ds(l, 1), :] + amt
                 liv[pl.ds(l, 1), :] = liv[pl.ds(l, 1), :] - cov
+                cumliv[:] = jnp.where(idx_l >= l, cumliv[:] - cov,
+                                      cumliv[:])
 
             bits = jnp.left_shift(
                 jnp.left_shift(jnp.int32(1), cov) - 1, k0)
@@ -726,6 +758,8 @@ def make_replayer_rle_mixed(
             pltpu.VMEM((NBLp, batch), jnp.int32),       # rws
             pltpu.VMEM((NBLp, batch), jnp.int32),       # liv
             pltpu.VMEM((NBLp, batch), jnp.int32),       # raw
+            pltpu.VMEM((NBLp, batch), jnp.int32),       # cumliv
+            pltpu.VMEM((NBLp, batch), jnp.int32),       # cumraw
             pltpu.VMEM((OT, LANES), jnp.int32),         # ordblk
             pltpu.VMEM((OT, LANES), jnp.int32),         # ol table
             pltpu.VMEM((OT, LANES), jnp.int32),         # or table
