@@ -26,7 +26,9 @@ done
 python - <<'EOF'
 import json
 rows = json.load(open("BENCH_ALL.json"))
-rows = [r for r in rows if r.get("cfg_key") != "northstar"]
+# Re-record the rows whose kernels changed this round: northstar (rle
+# incremental descent) and config 4 (rle-mixed incremental prefixes).
+rows = [r for r in rows if r.get("cfg_key") not in ("northstar", "4")]
 json.dump(rows, open("BENCH_ALL.json", "w"), indent=1)
 EOF
 exec python bench.py --config all --resume >> perf/bench_all_r4c.log 2>&1
