@@ -34,7 +34,8 @@ from text_crdt_rust_tpu.utils.testdata import (
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
-                    help="3 headline configs only")
+                    help="the 5 headline configs only (re-records + "
+                         "the two measured-capacity geometries)")
     ap.add_argument("--out", default="perf/sweep_r4.json")
     args = ap.parse_args()
 
@@ -46,19 +47,28 @@ def main():
     n_ops = len(patches)
     want = data.end_content
 
-    # (batch, block_k, groups); capacity 32768 run rows throughout.
+    # (batch, block_k, groups, capacity). capacity=0 -> the shipped
+    # 32768-row budget. 20992 = 164 blocks of 128: the MEASURED
+    # physical requirement of this trace (interpret-mode kernel ground
+    # truth: 162 blocks = 20,736 rows) plus TWO spare blocks — the
+    # "smaller planes" lever: -36% plane VMEM admits 384-512 lanes.
+    # Overflow is loud (capacity error flag), never silent.
     configs = [
-        (128, 256, 1),   # committed r3 row (637x) — re-record
-        (256, 128, 1),   # claimed 1026x geometry
-        (384, 256, 1),   # claimed 1035x geometry
+        (128, 256, 1, 0),   # committed r3 row (637x) — re-record
+        (256, 128, 1, 0),   # claimed 1026x geometry
+        (384, 256, 1, 0),   # claimed 1035x geometry
+        (384, 128, 1, 20992),  # measured-capacity, 1.5x lanes
+        (512, 128, 1, 20992),  # measured-capacity, 2x lanes
     ]
     if not args.quick:
         configs += [
-            (256, 256, 1),
-            (256, 64, 1),
-            (128, 128, 2),   # smaller planes x more groups (PERF §6.5)
-            (128, 64, 4),
-            (256, 128, 4),   # 1024 docs in one launch
+            (256, 256, 1, 0),
+            (256, 64, 1, 0),
+            (256, 128, 1, 20992),
+            (128, 128, 2, 0),   # smaller planes x more groups (PERF §6.5)
+            (128, 64, 4, 0),
+            (256, 128, 4, 0),   # 1024 docs in one launch
+            (256, 128, 40, 20992),  # 10,240 docs in ONE launch
         ]
 
     rows = []
@@ -69,10 +79,11 @@ def main():
 
     dev = jax.devices()[0]
     print(f"device: {dev.platform} {dev.device_kind}", flush=True)
-    for batch, block_k, groups in configs:
-        tag = f"b{batch}/k{block_k}/g{groups}"
+    for batch, block_k, groups, cap in configs:
+        tag = f"b{batch}/k{block_k}/g{groups}/c{cap or 32768}"
         try:
-            capacity = ((32768 + block_k - 1) // block_k) * block_k
+            capacity = (((cap or 32768) + block_k - 1)
+                        // block_k) * block_k
             stream = [ops] * groups if groups > 1 else ops
             run = R.make_replayer_rle(stream, capacity=capacity,
                                       batch=batch, block_k=block_k,
@@ -98,6 +109,7 @@ def main():
             ok = got == want
             ops_s = n_ops * batch * groups / wall
             row = {"batch": batch, "block_k": block_k, "groups": groups,
+                   "capacity": capacity,
                    "kernel_wall_s": round(wall, 4),
                    "ops_per_sec": round(ops_s, 1),
                    "compile_s": round(compile_s, 1),
@@ -106,6 +118,7 @@ def main():
                   f"(wall {wall*1e3:.1f}ms, ok={ok})", flush=True)
         except Exception as e:
             row = {"batch": batch, "block_k": block_k, "groups": groups,
+                   "capacity": capacity,
                    "error": f"{type(e).__name__}: {str(e)[:300]}"}
             print(f"{tag}: FAILED {type(e).__name__}", flush=True)
         rows.append(row)

@@ -8,6 +8,7 @@ and Mosaic-level compile coverage is ``perf/compile_pin.py``'s job on
 the real chip).
 """
 import numpy as np
+import pytest
 
 from text_crdt_rust_tpu.ops import batch as B
 from text_crdt_rust_tpu.ops import rle as R
@@ -27,11 +28,17 @@ def _patches():
     ]
 
 
-def test_northstar_geometry_256_lanes_interpret():
+@pytest.mark.parametrize("batch", (256, 384))
+def test_northstar_geometry_lanes_interpret(batch):
+    """Pin the kernel CONSTRUCT MIX at the big-batch lane counts (the
+    256-lane recorded row and the 384-lane measured-capacity geometry).
+    Capacity stays tiny here — interpret cost scales with
+    capacity*batch; the real 20,992/32,768-row shapes are exercised on
+    chip by perf/sweep_r4.py and bench.py."""
     patches = _patches()
     merged = B.merge_patches(patches)
     ops, _ = B.compile_local_patches(merged, lmax=16, dmax=None)
-    run = R.make_replayer_rle(ops, capacity=256, batch=256, block_k=128,
+    run = R.make_replayer_rle(ops, capacity=256, batch=batch, block_k=128,
                               chunk=64, interpret=True)
     res = run()
     want = ""
@@ -39,7 +46,8 @@ def test_northstar_geometry_256_lanes_interpret():
         want = want[:p.pos] + p.ins_content + want[p.pos + p.del_len:]
     got = SA.to_string(R.rle_to_flat(ops, res))
     assert got == want
-    # Every lane of the 256 must hold identical state.
+    # Every lane must hold identical state (catches lane-indexing bugs
+    # above the first 128/256 lanes).
     ordp = np.asarray(res.ordp)
     assert (ordp == ordp[:, :1]).all()
 
