@@ -31,4 +31,7 @@ rows = json.load(open("BENCH_ALL.json"))
 rows = [r for r in rows if r.get("cfg_key") not in ("northstar", "4")]
 json.dump(rows, open("BENCH_ALL.json", "w"), indent=1)
 EOF
-exec python bench.py --config all --resume >> perf/bench_all_r4c.log 2>&1
+python bench.py --config all --resume >> perf/bench_all_r4c.log 2>&1
+# One TPU process at a time: the sweep (measured-capacity geometries,
+# 10k-doc single launch) runs only after the suite finishes.
+exec python perf/sweep_r4.py --quick >> perf/sweep_r4_run.log 2>&1
