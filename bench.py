@@ -605,11 +605,12 @@ def cfg_4(args):
     ops, _ = B.compile_remote_txns(txns, table, lmax=min(16, run_len * 2),
                                    dmax=16)
     total_chars = n_peers * rounds * run_len
-    batch4 = min(args.batch, 128) if args.batch else 128
     # Suite-wide --engine values cfg_4 doesn't distinguish (rle-hbm,
     # blocked, ...) fall back to the default run engine rather than
     # failing the whole config.
     if args.engine == "blocked-mixed":
+        # The per-char blocked engine is VMEM-bound at 128 lanes.
+        batch4 = min(args.batch, 128) if args.batch else 128
         capacity = 2 << int(np.ceil(np.log2(max(total_chars, 256))))
         block_k = min(256, capacity // 2)
         run = BM.make_replayer_mixed(ops, capacity=capacity, batch=batch4,
@@ -618,6 +619,11 @@ def cfg_4(args):
                                      interpret=args.interpret)
         engine, to_flat = "blocked-mixed", BL.blocked_to_flat
     else:
+        # The run engine's planes (~9.6k rows) fit 512 lanes — and its
+        # step cost is dominated by lane-independent sequencing (scalar
+        # table reads, lane reductions), so wider batches are nearly
+        # free.
+        batch4 = args.batch or 128
         # Run capacity: every storm op splices <= 3 rows; 2x headroom.
         n_steps_cap = max(int(ops.num_steps * 3), 256)
         block_k = 128
