@@ -17,8 +17,9 @@ whole BASELINE.json table and writes it to ``BENCH_ALL.json``:
    per-lane rle engine, warm-started across chunks with checkpoint
    resync.
 kevin: 5M single-char prepends (`benches/yjs.rs:51-62`) on the native
-   engine; the TPU row runs 1M prepends on the HBM-state RLE engine
-   (leaf splits amortize the prepend worst case).
+   engine AND at full 5M scale on the HBM-state RLE engine (leaf
+   splits amortize the prepend worst case; batch 128, origins not
+   stored — see cfg_kevin's HBM math).
 
 Every row reports ops/sec/chip, ``mean_step_latency_us`` (wall / device
 steps), accounted + measured HBM bytes, slope-fit timing fields (see
