@@ -160,6 +160,11 @@ class RowSink:
                             if r.get("variant") == variant]
                     if key and same:
                         self.pending[key] = same
+                    elif same:
+                        # Keyless (legacy / hand-edited) same-variant
+                        # rows have no cfg_key for add() to supersede:
+                        # keep them outright, never silently erase.
+                        self.kept.extend(same)
             log(f"resume: {len(self.done_keys)} configs already recorded "
                 f"clean in {path}: {sorted(self.done_keys)}; "
                 f"{len(self.kept)} other-variant rows preserved; "

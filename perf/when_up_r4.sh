@@ -1,8 +1,9 @@
 #!/bin/bash
 # Round-4 tunnel-recovery watcher: wait for the TPU to come back, then
-# (1) drop the northstar row so it re-records on the incremental-descent
-# kernel, (2) run the suite with --resume (configs 1-5 keep their clean
-# rows; northstar + kevin run fresh). Safe to re-run: the backup is
+# (1) drop the northstar + config-4 rows so they re-record on the
+# incremental-descent / incremental-prefix kernels, (2) run the suite
+# with --resume (configs 1-3,5 keep their clean rows; northstar,
+# config 4 and kevin's error row run fresh). Safe to re-run: the backup is
 # taken once (cp -n) and any failure before the bench aborts the script
 # instead of silently resuming past a stale row.
 set -eu
@@ -24,12 +25,14 @@ assert float(np.asarray(x @ x)[0,0]) == 128.0
   sleep 180
 done
 python - <<'EOF'
-import json
+import json, os
 rows = json.load(open("BENCH_ALL.json"))
 # Re-record the rows whose kernels changed this round: northstar (rle
 # incremental descent) and config 4 (rle-mixed incremental prefixes).
 rows = [r for r in rows if r.get("cfg_key") not in ("northstar", "4")]
-json.dump(rows, open("BENCH_ALL.json", "w"), indent=1)
+with open("BENCH_ALL.json.tmp", "w") as f:
+    json.dump(rows, f, indent=1)
+os.replace("BENCH_ALL.json.tmp", "BENCH_ALL.json")
 EOF
 python bench.py --config all --resume >> perf/bench_all_r4c.log 2>&1
 # One TPU process at a time: the sweep (measured-capacity geometries,

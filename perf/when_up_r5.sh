@@ -1,0 +1,52 @@
+#!/bin/bash
+# Round-5 tunnel-recovery watcher. EVERY kernel benched in round 4
+# changed after the 05:23 records (VERDICT r4 weak #1), so round 5
+# re-records the WHOLE table fresh at HEAD: back up the stale table,
+# move it aside, run the full suite (with --resume so a crash-restart
+# keeps finished rows), then the geometry sweep. Safe to re-run.
+set -eu
+cd /root/repo
+if [ -f BENCH_ALL.json ] && [ ! -e perf/BENCH_ALL_r4_stale.json ]; then
+  # The r4 rows describe pre-outage kernels; archive, don't resume them.
+  cp BENCH_ALL.json perf/BENCH_ALL_r4_stale.json
+  python - <<'EOF'
+import json, os
+rows = json.load(open("BENCH_ALL.json"))
+for r in rows:
+    r["stale"] = "r4-pre-outage kernels; superseded by r5 re-record"
+with open("BENCH_ALL.json.tmp", "w") as f:
+    json.dump(rows, f, indent=1)
+os.replace("BENCH_ALL.json.tmp", "BENCH_ALL.json")
+EOF
+fi
+while true; do
+  if timeout 240 python -c "
+import jax, numpy as np, jax.numpy as jnp
+x = jnp.ones((128,128), jnp.bfloat16)
+assert float(np.asarray(x @ x)[0,0]) == 128.0
+" >/dev/null 2>&1; then
+    echo "$(date -u +%H:%M:%S) tunnel is back" >> perf/when_up_r5.log
+    break
+  fi
+  echo "$(date -u +%H:%M:%S) still down" >> perf/when_up_r5.log
+  sleep 120
+done
+# Fresh table: drop the stale-stamped r4 rows BEFORE --resume sees
+# them. This step is load-bearing: their variant string matches
+# HEAD's defaults, so without the drop RowSink would count them as
+# clean same-variant rows, mark those configs done, and skip the
+# re-record — exactly the stale-table failure this script exists
+# to prevent. (A crash-restart mid-suite is still safe: fresh rows
+# carry no "stale" key and are kept.)
+python - <<'EOF'
+import json, os
+if os.path.exists("BENCH_ALL.json"):
+    rows = [r for r in json.load(open("BENCH_ALL.json"))
+            if not r.get("stale")]
+    with open("BENCH_ALL.json.tmp", "w") as f:
+        json.dump(rows, f, indent=1)
+    os.replace("BENCH_ALL.json.tmp", "BENCH_ALL.json")
+EOF
+python bench.py --config all --resume >> perf/bench_all_r5.log 2>&1
+# One TPU process at a time: the geometry sweep runs after the suite.
+exec python perf/sweep_r4.py --quick >> perf/sweep_r5_run.log 2>&1
