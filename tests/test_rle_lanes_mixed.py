@@ -1,0 +1,358 @@
+"""Per-lane divergent MIXED engine (remote ops on per-lane run state)
+vs oracle.
+
+Interpreter-mode differential tests.  Every lane carries a DIFFERENT
+stream — the production sync shape the lockstep ``rle_mixed`` engine
+can't run (VERDICT r4 missing #2) — including per-lane remote YATA
+integrations, fragmented/double deletes, mixed local+remote lanes in
+the same step, and warm-started chunk chaining.
+"""
+import random
+
+import numpy as np
+import pytest
+
+from text_crdt_rust_tpu.common import (
+    RemoteDel,
+    RemoteId,
+    RemoteIns,
+    RemoteTxn,
+)
+from text_crdt_rust_tpu.models.oracle import ListCRDT
+from text_crdt_rust_tpu.models.sync import export_txns_since
+from text_crdt_rust_tpu.ops import batch as B
+from text_crdt_rust_tpu.ops import rle_lanes as RL
+from text_crdt_rust_tpu.ops import rle_lanes_mixed as RLM
+
+from test_device_flat import oracle_from_patches, random_patches
+
+ROOT = RemoteId("ROOT", 0xFFFFFFFF)
+
+
+def compile_txn_lanes(lane_txns, lmax=4):
+    """Per-lane RemoteTxn lists -> stacked [S, B] op tensors."""
+    opses = []
+    for txns in lane_txns:
+        table = B.AgentTable()
+        for t in txns:
+            table.add(t.id.agent)
+            for op in t.ops:
+                if hasattr(op, "id"):
+                    table.add(op.id.agent)
+        ops, _ = B.compile_remote_txns(txns, table, lmax=lmax, dmax=16)
+        opses.append(ops)
+    return B.stack_ops(opses)
+
+
+def oracle_txns(txns):
+    doc = ListCRDT()
+    for t in txns:
+        doc.apply_remote_txn(t)
+    return doc
+
+
+def lane_signed(res, d):
+    return RL.expand_lane(res, d).tolist()
+
+
+def oracle_signed(doc):
+    return [(-1 if doc.deleted[i] else 1) * (int(doc.order[i]) + 1)
+            for i in range(doc.n)]
+
+
+def lane_string(stacked, res, d):
+    """Lane content from device state + the stream's compile-time chars."""
+    chars = {}
+    ilens = np.asarray(stacked.ins_len)[:, d]
+    starts = np.asarray(stacked.ins_order_start)[:, d]
+    cps = np.asarray(stacked.chars)[:, d]
+    for s in np.nonzero(ilens)[0]:
+        for j in range(int(ilens[s])):
+            chars[int(starts[s]) + j] = chr(int(cps[s, j]))
+    return "".join(chars[int(o) - 1]
+                   for o in RL.expand_lane(res, d) if o > 0)
+
+
+def assert_lane_equals_oracle(stacked, res, d, oracle):
+    assert lane_signed(res, d) == oracle_signed(oracle), f"lane {d}"
+    assert lane_string(stacked, res, d) == oracle.to_string(), f"lane {d}"
+
+
+class TestDivergentRemoteLanes:
+    def test_two_lanes_different_tiebreaks(self):
+        # Lane 0 and lane 1 get DIFFERENT concurrent-insert storms; the
+        # name tiebreak must resolve per lane (`doc.rs:206-216`).
+        lane_txns = [
+            [RemoteTxn(id=RemoteId(n, 0), parents=[],
+                       ops=[RemoteIns(ROOT, ROOT, t)])
+             for n, t in [("zed", "zz"), ("amy", "aa"), ("mia", "mm")]],
+            [RemoteTxn(id=RemoteId(n, 0), parents=[],
+                       ops=[RemoteIns(ROOT, ROOT, t)])
+             for n, t in [("bob", "b"), ("eve", "ee"), ("cat", "c")]],
+        ]
+        stacked = compile_txn_lanes(lane_txns)
+        res = RLM.replay_lanes_mixed(stacked, capacity=64, chunk=8,
+                                     interpret=True)
+        res.check()
+        for d, txns in enumerate(lane_txns):
+            assert_lane_equals_oracle(stacked, res, d, oracle_txns(txns))
+
+    @pytest.mark.parametrize("seed", [3, 21])
+    def test_divergent_two_peer_merges(self, seed):
+        # Each lane replays a DIFFERENT two-peer merge.
+        rng = random.Random(seed)
+        lane_txns = []
+        for _ in range(4):
+            pa, _ = random_patches(rng, 25)
+            pb, _ = random_patches(rng, 25)
+            a = oracle_from_patches(pa, agent="peer-a")
+            b = oracle_from_patches(pb, agent="peer-b")
+            lane_txns.append(export_txns_since(a, 0)
+                             + export_txns_since(b, 0))
+        stacked = compile_txn_lanes(lane_txns)
+        res = RLM.replay_lanes_mixed(stacked, capacity=512, chunk=16,
+                                     interpret=True)
+        res.check()
+        for d, txns in enumerate(lane_txns):
+            assert_lane_equals_oracle(stacked, res, d, oracle_txns(txns))
+
+    def test_fragmented_and_double_delete_lanes(self):
+        # Lane 0: fragmented + concurrent double delete; lane 1: a long
+        # chunked delete (> dmax targets); lane 2: delete-then-insert
+        # into the tombstone (the sign-preserving raw splice).
+        l0 = [
+            RemoteTxn(id=RemoteId("amy", 0), parents=[],
+                      ops=[RemoteIns(ROOT, ROOT, "abcdef")]),
+            RemoteTxn(id=RemoteId("bob", 0), parents=[RemoteId("amy", 5)],
+                      ops=[RemoteDel(RemoteId("amy", 1), 3)]),
+            RemoteTxn(id=RemoteId("cat", 0), parents=[RemoteId("amy", 5)],
+                      ops=[RemoteDel(RemoteId("amy", 2), 3)]),
+        ]
+        l1 = [
+            RemoteTxn(id=RemoteId("amy", 0), parents=[],
+                      ops=[RemoteIns(ROOT, ROOT, "x" * 50)]),
+            RemoteTxn(id=RemoteId("bob", 0), parents=[RemoteId("amy", 49)],
+                      ops=[RemoteDel(RemoteId("amy", 5), 40)]),
+        ]
+        l2 = [
+            RemoteTxn(id=RemoteId("amy", 0), parents=[],
+                      ops=[RemoteIns(ROOT, ROOT, "abcdefgh")]),
+            RemoteTxn(id=RemoteId("amy", 8), parents=[RemoteId("amy", 7)],
+                      ops=[RemoteDel(RemoteId("amy", 2), 4)]),
+            RemoteTxn(id=RemoteId("bob", 0), parents=[RemoteId("amy", 7)],
+                      ops=[RemoteIns(RemoteId("amy", 3),
+                                     RemoteId("amy", 4), "XY")]),
+        ]
+        lane_txns = [l0, l1, l2]
+        stacked = compile_txn_lanes(lane_txns, lmax=16)
+        res = RLM.replay_lanes_mixed(stacked, capacity=128, chunk=16,
+                                     interpret=True)
+        res.check()
+        oracles = [oracle_txns(t) for t in lane_txns]
+        assert oracles[0].to_string() == "af"
+        assert oracles[1].to_string() == "x" * 10
+        for d in range(3):
+            assert_lane_equals_oracle(stacked, res, d, oracles[d])
+
+    def test_mixed_local_and_remote_lanes_same_step(self):
+        # Lane 0 applies LOCAL ops while lane 1 applies REMOTE ops in the
+        # SAME kernel steps — all four dispatch branches masked per lane.
+        rng = random.Random(11)
+        patches, content = random_patches(rng, 30)
+        local_ops, _ = B.compile_local_patches(
+            B.merge_patches(patches), lmax=8, dmax=None)
+
+        pa, _ = random_patches(rng, 20)
+        a = oracle_from_patches(pa, agent="peer-a")
+        txns = export_txns_since(a, 0)
+        table = B.AgentTable()
+        for t in txns:
+            table.add(t.id.agent)
+        remote_ops, _ = B.compile_remote_txns(txns, table, lmax=8, dmax=16)
+
+        stacked = B.stack_ops([local_ops, remote_ops])
+        res = RLM.replay_lanes_mixed(stacked, capacity=256, chunk=16,
+                                     interpret=True)
+        res.check()
+        assert lane_string(stacked, res, 0) == content
+        assert_lane_equals_oracle(stacked, res, 1, oracle_txns(txns))
+
+    def test_local_lanes_match_rle_lanes_engine(self):
+        # Pure-local stacked streams: state must equal ops.rle_lanes.
+        rng = random.Random(7)
+        streams = [random_patches(rng, 30 + rng.randint(0, 20))[0]
+                   for _ in range(8)]
+        lmax = max(len(p.ins_content) for ps in streams for p in ps) or 1
+        opses = [B.compile_local_patches(ps, lmax=lmax, dmax=None)[0]
+                 for ps in streams]
+        stacked = B.stack_ops(opses)
+        res = RLM.replay_lanes_mixed(stacked, capacity=256, chunk=16,
+                                     interpret=True)
+        ref = RL.replay_lanes(stacked, capacity=256, chunk=16,
+                              interpret=True)
+        res.check()
+        ref.check()
+        for a, b in ((res.ordp, ref.ordp), (res.lenp, ref.lenp),
+                     (res.rows, ref.rows), (res.ol, ref.ol),
+                     (res.orr, ref.orr)):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    @pytest.mark.parametrize("seed", [1, 17])
+    def test_n_peer_interleavings_converge_per_lane(self, seed):
+        # Each LANE applies a different causally-valid interleaving of
+        # the same three peer streams; all lanes must converge to one
+        # content and match the oracle under their own interleaving.
+        rng = random.Random(seed)
+        streams = []
+        for name in ("kim", "lou", "max"):
+            patches, _ = random_patches(rng, 15)
+            streams.append(export_txns_since(
+                oracle_from_patches(patches, agent=name), 0))
+
+        def interleave(order_rng):
+            queues = [list(s) for s in streams]
+            out = []
+            while any(queues):
+                live = [q for q in queues if q]
+                out.append(order_rng.choice(live).pop(0))
+            return out
+
+        lane_txns = [interleave(random.Random(seed * 100 + k))
+                     for k in range(4)]
+        stacked = compile_txn_lanes(lane_txns)
+        res = RLM.replay_lanes_mixed(stacked, capacity=512, chunk=16,
+                                     interpret=True)
+        res.check()
+        contents = []
+        for d, txns in enumerate(lane_txns):
+            oracle = oracle_txns(txns)
+            assert_lane_equals_oracle(stacked, res, d, oracle)
+            contents.append(oracle.to_string())
+        assert len(set(contents)) == 1, "interleavings diverged"
+
+
+class TestWarmStartChaining:
+    def test_remote_chunks_resume_on_device(self):
+        # A peer's edit log split into two compiled chunks; chunk 2
+        # resumes from chunk 1's device state (tables carried via the
+        # sentinel merge) — the config-5 streaming shape with REMOTE ops.
+        rng = random.Random(42)
+        docs = 4
+        lane_peers = []
+        for d in range(docs):
+            patches, _ = random_patches(rng, 40)
+            lane_peers.append(oracle_from_patches(
+                patches, agent=f"peer{d}"))
+        lane_txns = [export_txns_since(p, 0) for p in lane_peers]
+        halves = [(t[: len(t) // 2], t[len(t) // 2:]) for t in lane_txns]
+
+        tables = [B.AgentTable() for _ in range(docs)]
+        assigners = [None] * docs
+
+        def compile_chunk(which):
+            opses = []
+            for d in range(docs):
+                txns = halves[d][which]
+                for t in txns:
+                    tables[d].add(t.id.agent)
+                ops, assigners[d] = B.compile_remote_txns(
+                    txns, tables[d], assigner=assigners[d], lmax=4,
+                    dmax=16)
+                opses.append(ops)
+            return B.stack_ops(opses)
+
+        c0 = compile_chunk(0)
+        run0 = RLM.make_replayer_lanes_mixed(
+            c0, capacity=256, order_capacity=512, chunk=16,
+            interpret=True)
+        r0 = run0()
+        r0.check()
+
+        c1 = compile_chunk(1)
+        # Host-accumulated full rank table across both chunks.
+        _, _, rkl0 = RLM.lane_tables(c0, 512)
+        _, _, rkl1 = RLM.lane_tables(c1, 512)
+        rkl = np.where(rkl1 != 0, rkl1, rkl0)
+        run1 = RLM.make_replayer_lanes_mixed(
+            c1, capacity=256, order_capacity=512, chunk=16,
+            init=r0.state(), rkl=rkl, interpret=True)
+        r1 = run1()
+        r1.check()
+
+        both = [np.concatenate([np.asarray(getattr(c0, f)),
+                                np.asarray(getattr(c1, f))])
+                for f in ("ins_len", "ins_order_start", "chars")]
+
+        class Joined:
+            ins_len, ins_order_start, chars = both
+
+        for d in range(docs):
+            oracle = oracle_txns(lane_txns[d])
+            assert lane_signed(r1, d) == oracle_signed(oracle), f"lane {d}"
+            assert (lane_string(Joined, r1, d)
+                    == oracle.to_string()), f"lane {d}"
+
+
+class TestErrorFlags:
+    def test_capacity_flag_per_lane(self):
+        lane_txns = [
+            [RemoteTxn(id=RemoteId("a", 0), parents=[],
+                       ops=[RemoteIns(ROOT, ROOT, "ab")])],
+            [RemoteTxn(id=RemoteId("a", 2 * k), parents=[],
+                       ops=[RemoteIns(
+                           ROOT if k == 0 else RemoteId("a", 2 * k - 1),
+                           ROOT, "ab")])
+             for k in range(30)],
+        ]
+        # Interleave each insert with a delete so runs can't merge and
+        # lane 1 overflows an 8-row capacity.
+        l1 = []
+        for k, t in enumerate(lane_txns[1]):
+            l1.append(t)
+            if k % 2 == 0:
+                l1.append(RemoteTxn(
+                    id=RemoteId("b", k // 2), parents=[],
+                    ops=[RemoteDel(RemoteId("a", 2 * k), 1)]))
+        lane_txns[1] = l1
+        stacked = compile_txn_lanes(lane_txns)
+        res = RLM.replay_lanes_mixed(stacked, capacity=8, chunk=8,
+                                     interpret=True)
+        with pytest.raises(RuntimeError, match="lanes \\[1\\]"):
+            res.check()
+
+    def test_remote_delete_walk_capacity_flag(self):
+        # Review r5 regression: the delete walk splits +2 rows per
+        # covered run, so capacity must be re-checked INSIDE the walk —
+        # at 8 rows capacity the 4th interior delete would overflow and
+        # pltpu.roll would silently wrap the plane.
+        txns = [RemoteTxn(id=RemoteId("amy", 0), parents=[],
+                          ops=[RemoteIns(ROOT, ROOT, "aaaaaaaa")])]
+        for k, s in enumerate((1, 3, 5, 6)):
+            txns.append(RemoteTxn(
+                id=RemoteId("bob", k), parents=[],
+                ops=[RemoteDel(RemoteId("amy", s), 1)]))
+        stacked = compile_txn_lanes([txns], lmax=8)
+        res = RLM.replay_lanes_mixed(stacked, capacity=8, chunk=8,
+                                     interpret=True)
+        with pytest.raises(RuntimeError, match="lanes \\[0\\]"):
+            res.check()
+
+    def test_missing_order_flag(self):
+        # An op referencing an order never inserted on this lane.
+        lane_txns = [[
+            RemoteTxn(id=RemoteId("a", 0), parents=[],
+                      ops=[RemoteIns(ROOT, ROOT, "ab")]),
+        ]]
+        stacked = compile_txn_lanes(lane_txns)
+        # Corrupt the stream: point a delete at an absent order.
+        import jax
+
+        stacked = jax.tree.map(lambda a: np.asarray(a).copy(), stacked)
+        stacked.kind[0, 0] = B.KIND_REMOTE_DEL
+        stacked.del_target[0, 0] = 90
+        stacked.del_len[0, 0] = 1
+        stacked.ins_len[0, 0] = 0
+        res = RLM.replay_lanes_mixed(stacked, capacity=16, chunk=8,
+                                     interpret=True)
+        with pytest.raises(RuntimeError, match="order lookup missed"):
+            res.check()

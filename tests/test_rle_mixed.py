@@ -97,6 +97,22 @@ class TestMixedRleRemote:
         assert SA.to_string(doc) == oracle.to_string()
         assert SA.doc_spans(doc) == oracle.doc_spans()
 
+    def test_order_contiguous_unchained_no_merge(self):
+        # Round-5 regression: three single-char root inserts get
+        # order-contiguous orders (0,1,2); zed's char must NOT merge
+        # into amy's run (its origin_left is ROOT, not amy), else the
+        # YATA run-skip hides it from mid's scan and the doc diverges
+        # (was: "azm" instead of "amz").
+        txns = [
+            RemoteTxn(id=RemoteId(n, 0), parents=[],
+                      ops=[RemoteIns(ROOT, ROOT, t)])
+            for n, t in [("amy", "a"), ("zed", "z"), ("mid", "m")]
+        ]
+        oracle = oracle_txns(txns)
+        doc = replay_txns(txns, capacity=64, block_k=8)
+        assert SA.to_string(doc) == oracle.to_string() == "amz"
+        assert SA.doc_spans(doc) == oracle.doc_spans()
+
     def test_remote_delete_fragmented_and_double(self):
         base = RemoteTxn(id=RemoteId("amy", 0), parents=[],
                          ops=[RemoteIns(ROOT, ROOT, "abcdef")])

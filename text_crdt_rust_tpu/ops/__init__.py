@@ -24,6 +24,11 @@ see ``span_arrays``) and cross-check bit-identically in ``tests/``:
                   remote YATA integrate + remote delete, `doc.rs:242-348`)
                   on the run representation — runs the config-4 storm on
                   state that is runs, not chars.
+- ``rle_lanes_mixed`` — the round-5 unification: the full op surface on
+                  PER-LANE DIVERGENT documents (each lane its own remote
+                  stream — the production sync shape; config 5's remote
+                  variant), with per-lane by-order origin tables and a
+                  lane-vectorized YATA scan.
 - ``blocked`` / ``blocked_hbm`` — the round-2 per-character block
                   engines (kept as references and for the unmerged-stream
                   path); ``blocked_mixed`` adds the remote-op hot path

@@ -25,7 +25,8 @@ lane assumption instead of the batching:
 
 Per step the kernel applies B independent ops (one per lane), so wall
 per op is ~1/B of a blocked-engine step on the same shapes. Local ops
-only (KIND_LOCAL); remote streams go to ``ops.blocked_mixed``/``flat``.
+only (KIND_LOCAL); per-lane REMOTE streams run on the unified
+``ops.rle_lanes_mixed`` engine built on these same primitives.
 """
 from __future__ import annotations
 
@@ -346,8 +347,8 @@ def make_replayer_lanes(
     _require(kinds.ndim == 2, "rle_lanes takes stacked per-doc streams "
              "([S, B] columns; see batch.stack_ops)")
     _require(bool((kinds == KIND_LOCAL).all()),
-             "rle_lanes replays local streams; remote ops -> "
-             "ops.blocked_mixed / ops.flat")
+             "rle_lanes replays local streams; per-lane remote "
+             "streams -> ops.rle_lanes_mixed")
     S, B = kinds.shape
     _require(capacity >= 8, "capacity must hold a few runs")
     s_pad = max(((S + chunk - 1) // chunk) * chunk, chunk)

@@ -1,0 +1,718 @@
+"""Per-lane divergent MIXED replay: B distinct documents, each applying
+its OWN local/remote op stream — the production sync shape.
+
+``ops.rle_mixed`` runs the full op surface (KIND_LOCAL/REMOTE_INS/
+REMOTE_DEL, `doc.rs:242-348`) but in LOCKSTEP: one shared scalar stream
+across identical lanes.  ``ops.rle_lanes`` runs divergent per-lane
+streams but refuses remote ops.  This engine is the round-5 unification
+(VERDICT r4 missing #2): thousands of *different* documents each
+receiving *its own* remote-op stream, one op per lane per kernel step.
+
+Design — rle_lanes' lane-vector layout carried over to the remote paths:
+
+- document state is the un-blocked run column pair ``ordp/lenp``
+  [CAP, B] (±(order+1), len) plus ``rows`` [1, B]; every op scalar of
+  ``rle_mixed`` becomes a [1, B] lane vector; splices stay <= 3 rows so
+  per-lane dynamic shifts are two static ``pltpu.roll``s blended by
+  per-lane masks (the rle_lanes trick);
+- **per-lane by-order tables** ``oll/orl/rkl`` [OCAP, B] (row = order,
+  lane = doc) replace rle_mixed's 128-orders/row packed tables: each
+  lane has its own order space, so the packing collapses to one row per
+  order and reads/writes are one masked [OCAP, B] pass.  Prefilled
+  host-side per lane (`batch._prefill_scatter`), sentinel −2 = unknown;
+  unknown entries are never probed (every existing char's entry was
+  prefilled or written by the local-insert path at insert time);
+- **no order->block hint table**: the lanes layout always works on the
+  whole [CAP, B] plane, so order lookup IS the one vectorized
+  range-test pass that rle_mixed's ``ordblk`` miss-path falls back to —
+  there is nothing to hint, go stale, or self-heal;
+- **run-level YATA integrate** (`doc.rs:167-234`) with PER-LANE scan
+  state: (cursor, scanning, scan_start, done) are [1, B] vectors; the
+  while-loop runs until every lane breaks (conflict-free lanes break on
+  the first probe, `doc.rs:192-194`, so iterations = the max conflict
+  depth across lanes, not the sum).  The raw prefix sum the scan
+  descends on is HOISTED out of the loop — the scan never mutates
+  state, so one ``_vcumsum`` serves every probe of the step;
+- **run-level remote delete**: the rle_mixed bitmask walk, lane-
+  vectorized — each iteration resolves every lane's lowest unhandled
+  target order to its run (one [CAP, B] range test), splits the covered
+  sub-range out as a tombstone (<= 3 parts), and clears the covered
+  bits; already-dead runs retire their bits without flipping
+  (idempotent concurrent deletes, `double_delete.rs:6-9`).
+
+State (ordp, lenp, rows, oll, orl) is a kernel input AND output — chunk
+N+1 resumes from chunk N on device (the config-5 streaming warm start),
+with each chunk's compile-known table entries merged in at step 0 via
+the −2 sentinel.  ``rkl`` is read-only (author ranks are compile-time
+facts; the host accumulates the full table across chunks).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .batch import (
+    KIND_LOCAL,
+    KIND_REMOTE_DEL,
+    KIND_REMOTE_INS,
+    OpTensors,
+    _prefill_scatter,
+)
+from .blocked import _require
+from .rle_lanes import LanesResult, _lane_tile, _vcumsum, _vrow, _vshift
+
+TAB_UNKNOWN = -2  # by-order table sentinel: entry not yet known
+
+
+def _low_bit_index(v):
+    """Per-lane floor(log2(lowest set bit)) of a [1, B] i32 vector
+    (Mosaic has no popcount; 5 shift probes cover 16-bit masks)."""
+    low = v & (-v)
+    k0 = jnp.zeros_like(v)
+    for sh in (16, 8, 4, 2, 1):
+        ge = (low >> sh) != 0
+        k0 = k0 + jnp.where(ge, sh, 0)
+        low = jnp.where(ge, low >> sh, low)
+    return k0
+
+
+def _mixed_lanes_kernel(
+    kind_ref, pos_ref, dlen_ref, dtgt_ref, olop_ref, orop_ref, rk_ref,
+    ilen_ref, start_ref,                        # [CHUNK, B] VMEM op columns
+    ord0_ref, len0_ref, rows0_ref,              # warm-start state inputs
+    oll0_ref, orl0_ref,                         # prior table state [OCAP, B]
+    olld_ref, orld_ref,                         # this stream's prefill delta
+    rkl_ref,                                    # ranks (read-only, full)
+    ol_ref, or_ref,                             # [CHUNK, B] origin outputs
+    ordp, lenp, rowsv,                          # state outputs (working)
+    oll, orl,                                   # table outputs (working)
+    err_ref,
+    *, CAP: int, OCAP: int, CHUNK: int, DMAX: int,
+):
+    B = ordp.shape[1]
+    i = pl.program_id(1)
+    idx = lax.broadcasted_iota(jnp.int32, (CAP, B), 0)
+    oidx = lax.broadcasted_iota(jnp.int32, (OCAP, B), 0)
+    root_i = jnp.int32(-1)  # ROOT_ORDER as i32
+    root_u = jnp.uint32(0xFFFFFFFF)
+
+    ol_ref[:] = jnp.zeros_like(ol_ref)
+    or_ref[:] = jnp.zeros_like(or_ref)
+
+    @pl.when(i == 0)
+    def _init():
+        ordp[:] = ord0_ref[:]
+        lenp[:] = len0_ref[:]
+        rowsv[:] = rows0_ref[:]
+        # Merge this stream's compile-known entries over the carried
+        # tables (chunk N+1's new orders were −2 in chunk N's state).
+        oll[:] = jnp.where(olld_ref[:] != TAB_UNKNOWN, olld_ref[:],
+                           oll0_ref[:])
+        orl[:] = jnp.where(orld_ref[:] != TAB_UNKNOWN, orld_ref[:],
+                           orl0_ref[:])
+        err_ref[:] = jnp.zeros_like(err_ref)
+
+    # ---- per-lane by-order table ops ------------------------------------
+
+    def t_read(tab, o):
+        """tab[o[lane], lane] as [1, B]; o values < 0 read row 0 (callers
+        mask ROOT probes before use)."""
+        oc = jnp.clip(o, 0, OCAP - 1)
+        return jnp.sum(jnp.where(oidx == oc, tab[:], 0), axis=0,
+                       keepdims=True)
+
+    def t_write(tab, act, o, v):
+        tab[:] = jnp.where(act & (oidx == o), v, tab[:])
+
+    def t_write_run(tab, act, st, ln, v):
+        tab[:] = jnp.where(act & (oidx >= st) & (oidx < st + ln), v,
+                           tab[:])
+
+    # ---- order -> run / position lookups --------------------------------
+
+    def find_run_of_order(o, need):
+        """Per-lane row/run containing order ``o`` ([1, B]): one
+        vectorized range test over the whole plane.  Raises the
+        missing-order flag for ``need`` lanes with no hit."""
+        bo = ordp[:]
+        so = jnp.abs(bo) - 1
+        hit = (bo != 0) & (so <= o) & (o < so + lenp[:])
+        found = jnp.sum(hit.astype(jnp.int32), axis=0, keepdims=True) > 0
+        row = jnp.min(jnp.where(hit, idx, CAP), axis=0, keepdims=True)
+
+        @pl.when(jnp.any(need & ~found))
+        def _missing():
+            err_ref[2:3, :] = jnp.where(need & ~found, 1, err_ref[2:3, :])
+
+        return jnp.where(found, row, 0), found
+
+    def raw_pos_of_order(o, need):
+        """Per-lane RAW document position of the char with order ``o``."""
+        row, _ = find_run_of_order(o, need)
+        raw_before = jnp.sum(jnp.where(idx < row, lenp[:], 0), axis=0,
+                             keepdims=True)
+        so_hit = jnp.abs(_vrow(ordp[:], row)) - 1
+        return raw_before + (o - so_hit)
+
+    def cursor_after(o, need):
+        is_root = o == root_i
+        p = raw_pos_of_order(jnp.maximum(o, 0), need & ~is_root)
+        return jnp.where(is_root, 0, p + 1)
+
+    # ---- local ops (rle_lanes paths + table upkeep) ---------------------
+
+    def flag_capacity(act):
+        @pl.when(jnp.any(act & (rowsv[:] + 2 > CAP)))
+        def _cap():
+            err_ref[0:1, :] = jnp.where(act & (rowsv[:] + 2 > CAP), 1,
+                                        err_ref[0:1, :])
+
+    def do_local_delete(act, p, d):
+        """Whole-doc single-pass tombstone (rle_lanes.do_delete)."""
+        flag_capacity(act)
+        bo = ordp[:]
+        bl = lenp[:]
+        lv = jnp.where(bo > 0, bl, 0)
+        cum = _vcumsum(lv)
+        before = cum - lv
+        rem = jnp.where(act, d, 0)
+        cs = jnp.clip(p - before, 0, lv)
+        ce = jnp.clip(p + rem - before, 0, lv)
+        cov = ce - cs
+        tot = jnp.sum(cov, axis=0, keepdims=True)
+
+        @pl.when(jnp.any(act & (tot < rem)))
+        def _bad():
+            err_ref[1:2, :] = jnp.where(act & (tot < rem), 1,
+                                        err_ref[1:2, :])
+
+        full = (cov > 0) & (cov == bl)
+        part = (cov > 0) & jnp.logical_not(full)
+        npart = jnp.sum(part.astype(jnp.int32), axis=0, keepdims=True)
+        i1 = jnp.min(jnp.where(part, idx, CAP), axis=0, keepdims=True)
+        i2 = jnp.max(jnp.where(part, idx, -1), axis=0, keepdims=True)
+        bo = jnp.where(act & full, -bo, bo)
+
+        def apply_partial(a, i_p, bo, bl):
+            o = _vrow(bo, i_p)
+            ln = _vrow(bl, i_p)
+            cs_i = _vrow(cs, i_p)
+            ce_i = _vrow(ce, i_p)
+            cov_i = ce_i - cs_i
+            has_head = (cs_i > 0) & a
+            has_tail = (ce_i < ln) & a
+            amt = has_head.astype(jnp.int32) + has_tail.astype(jnp.int32)
+            so = _vshift(bo, amt)
+            sl = _vshift(bl, amt)
+            no = jnp.where(idx <= i_p, bo, so)
+            nl = jnp.where(idx <= i_p, bl, sl)
+            p0o = jnp.where(has_head, o, -(o + cs_i))
+            p0l = jnp.where(has_head, cs_i, cov_i)
+            p1o = jnp.where(has_head, -(o + cs_i), o + ce_i)
+            p1l = jnp.where(has_head, cov_i, ln - ce_i)
+            w0 = a & (idx == i_p)
+            no = jnp.where(w0, p0o, no)
+            nl = jnp.where(w0, p0l, nl)
+            w1 = a & (idx == i_p + 1) & (amt >= 1)
+            no = jnp.where(w1, p1o, no)
+            nl = jnp.where(w1, p1l, nl)
+            w2 = a & (idx == i_p + 2) & (amt == 2)
+            no = jnp.where(w2, o + ce_i, no)
+            nl = jnp.where(w2, ln - ce_i, nl)
+            return no, nl, amt
+
+        bo, bl, a2 = apply_partial(act & (npart >= 1), i2, bo, bl)
+        bo, bl, a1 = apply_partial(act & (npart == 2), i1, bo, bl)
+        ordp[:] = bo
+        lenp[:] = bl
+        rowsv[:] = rowsv[:] + jnp.where(act, a1 + a2, 0)
+
+    def do_local_insert(act, k, p, il, st):
+        """rle_lanes.do_insert + by-order table upkeep (the origins a
+        local insert discovers at apply time, `doc.rs:447-453`)."""
+        flag_capacity(act)
+        rows = rowsv[:]
+        bo = ordp[:]
+        bl = lenp[:]
+        lv = jnp.where(bo > 0, bl, 0)
+        cum = _vcumsum(lv)
+        local = jnp.where(act, p, 0)
+        i_r = jnp.sum(((cum < local) & (idx < rows)).astype(jnp.int32),
+                      axis=0, keepdims=True)
+        o_r = _vrow(bo, i_r)
+        l_r = _vrow(bl, i_r)
+        off = local - (_vrow(cum, i_r) - _vrow(lv, i_r))
+
+        left = jnp.where(p == 0, root_i, (o_r - 1) + (off - 1))
+        mrg = act & (p > 0) & (off == l_r) & ((st + 1) == (o_r + l_r))
+        is_split = act & (p > 0) & (off < l_r)
+
+        nxt_in_blk = _vrow(bo, i_r + 1)
+        first_o = _vrow(bo, 0)
+        succ_p0 = jnp.where(rows > 0, first_o, 0)
+        succ_after = jnp.where(i_r + 1 < rows, nxt_in_blk, 0)
+        succ = jnp.where(p == 0, succ_p0,
+                         jnp.where(is_split, o_r + off, succ_after))
+        right = jnp.where(succ == 0, root_i, jnp.abs(succ) - 1)
+
+        ins_at = jnp.where(p == 0, 0, i_r + 1)
+        amt = jnp.where(jnp.logical_not(act) | mrg, 0,
+                        jnp.where(is_split, 2, 1))
+        so = _vshift(bo, amt)
+        sl = _vshift(bl, amt)
+        no = jnp.where(idx < ins_at, bo, so)
+        nl = jnp.where(idx < ins_at, bl, sl)
+        nl = jnp.where(is_split & (idx == i_r), off, nl)
+        new_run = act & jnp.logical_not(mrg) & (idx == ins_at)
+        no = jnp.where(new_run, st + 1, no)
+        nl = jnp.where(new_run, il, nl)
+        tail = is_split & (idx == ins_at + 1)
+        no = jnp.where(tail, o_r + off, no)
+        nl = jnp.where(tail, l_r - off, nl)
+        nl = jnp.where(mrg & (idx == i_r), l_r + il, nl)
+        ordp[:] = no
+        lenp[:] = nl
+        rowsv[:] = rows + amt
+
+        t_write(oll, act, st, left)
+        t_write_run(orl, act, st, il, right)
+        ol_ref[pl.ds(k, 1), :] = jnp.where(
+            act, left.astype(jnp.uint32), ol_ref[pl.ds(k, 1), :])
+        or_ref[pl.ds(k, 1), :] = jnp.where(
+            act, right.astype(jnp.uint32), or_ref[pl.ds(k, 1), :])
+
+    # ---- remote insert (`doc.rs:274-293` -> integrate) ------------------
+
+    def integrate_cursor(act, my_rank, o_left, o_right):
+        """Per-lane YATA conflict scan over runs (rle_mixed
+        ``integrate_cursor`` with [1, B] scan state).  The raw prefix is
+        hoisted: the scan mutates nothing, so one cumsum serves every
+        probe of every lane this step."""
+        cumraw = _vcumsum(lenp[:])
+        n = jnp.sum(lenp[:], axis=0, keepdims=True)
+        cursor0 = cursor_after(o_left, act)
+        left_cursor = cursor0
+
+        def run_at_raw(c):
+            i_r = jnp.sum(((cumraw <= c) & (idx < rowsv[:])).astype(
+                jnp.int32), axis=0, keepdims=True)
+            o_r = _vrow(ordp[:], i_r)
+            l_r = _vrow(lenp[:], i_r)
+            off = c - (_vrow(cumraw, i_r) - l_r)
+            return o_r, l_r, off
+
+        def cond(state):
+            cursor, scanning, scan_start, done = state
+            return jnp.any(~done & (cursor < n))
+
+        def body(state):
+            cursor, scanning, scan_start, done = state
+            o_r, l_r, off = run_at_raw(cursor)
+            so = jnp.abs(o_r) - 1
+            other_order = so + off
+            live = ~done & (cursor < n)
+            other_left = t_read(oll, other_order)
+            other_right = t_read(orl, other_order)
+            other_rank = t_read(rkl_ref, other_order)
+            olc = cursor_after(other_left, live)
+            brk = (other_order == o_right) | (olc < left_cursor)
+            eq = ~brk & (olc == left_cursor)
+            gt = my_rank > other_rank
+            brk = brk | (eq & ~gt & (o_right == other_right))
+            starts_scan = eq & ~gt & (o_right != other_right)
+            new_scan_start = jnp.where(
+                live & starts_scan & ~scanning, cursor, scan_start)
+            new_scanning = jnp.where(
+                live & eq,
+                jnp.where(gt, False,
+                          jnp.where(o_right == other_right, scanning,
+                                    True)),
+                scanning)
+            contains_right = (o_right > other_order) & (o_right < so + l_r)
+            step = jnp.where(contains_right, o_right - other_order,
+                             l_r - off)
+            new_cursor = jnp.where(live & ~brk, cursor + step, cursor)
+            return (new_cursor, new_scanning, new_scan_start,
+                    done | brk | (cursor >= n))
+
+        f = jnp.zeros_like(cursor0) != 0  # [1, B] False
+        init = (cursor0, f, cursor0, ~act)
+        cursor, scanning, scan_start, _ = lax.while_loop(cond, body, init)
+        return jnp.where(scanning, scan_start, cursor), cumraw
+
+    def do_remote_insert(act, k, my_rank, o_left, o_right, il, st):
+        flag_capacity(act)
+        c, cumraw = integrate_cursor(act, my_rank, o_left, o_right)
+        rows = rowsv[:]
+        bo = ordp[:]
+        bl = lenp[:]
+        local = jnp.where(act, c, 0)
+        i_r = jnp.sum(((cumraw < local) & (idx < rows)).astype(jnp.int32),
+                      axis=0, keepdims=True)
+        o_r = _vrow(bo, i_r)
+        l_r = _vrow(bl, i_r)
+        off = local - (_vrow(cumraw, i_r) - l_r)
+
+        # Raw-position splice (`rle_mixed._insert_splice_raw` lane-wise):
+        # the split run may be a TOMBSTONE (preserve sign on the tail);
+        # the merge fast path additionally requires a live predecessor
+        # AND the op's origin_left chaining to the run's last char — the
+        # YATA run-skip evaluates only run heads on the premise that
+        # non-head chars' origin_left is their own predecessor, so an
+        # unchained merge would hide a char the scan must evaluate.
+        mrg = act & (c > 0) & (o_r > 0) & (off == l_r) & \
+            ((st + 1) == (o_r + l_r)) & (o_left == o_r + l_r - 2)
+        is_split = act & (c > 0) & (off < l_r)
+        ins_at = jnp.where(c == 0, 0, i_r + 1)
+        amt = jnp.where(jnp.logical_not(act) | mrg, 0,
+                        jnp.where(is_split, 2, 1))
+        so = _vshift(bo, amt)
+        sl = _vshift(bl, amt)
+        no = jnp.where(idx < ins_at, bo, so)
+        nl = jnp.where(idx < ins_at, bl, sl)
+        nl = jnp.where(is_split & (idx == i_r), off, nl)
+        new_run = act & jnp.logical_not(mrg) & (idx == ins_at)
+        no = jnp.where(new_run, st + 1, no)
+        nl = jnp.where(new_run, il, nl)
+        tail = is_split & (idx == ins_at + 1)
+        tail_o = jnp.where(o_r > 0, o_r + off, o_r - off)
+        no = jnp.where(tail, tail_o, no)
+        nl = jnp.where(tail, l_r - off, nl)
+        nl = jnp.where(mrg & (idx == i_r), l_r + il, nl)
+        ordp[:] = no
+        lenp[:] = nl
+        rowsv[:] = rows + amt
+
+        # Remote origins are compile-time facts already prefilled into
+        # the tables; only the per-op outputs remain.
+        ol_ref[pl.ds(k, 1), :] = jnp.where(
+            act, o_left.astype(jnp.uint32), ol_ref[pl.ds(k, 1), :])
+        or_ref[pl.ds(k, 1), :] = jnp.where(
+            act, o_right.astype(jnp.uint32), or_ref[pl.ds(k, 1), :])
+
+    # ---- remote delete (`doc.rs:295-340`) -------------------------------
+
+    def do_remote_delete(act, t, dlen):
+        """Per-lane bitmask walk over the <= DMAX-long target range: each
+        iteration retires every lane's lowest unhandled order.  Capacity
+        is checked inside the walk (each covered run can split +2 rows),
+        not at op entry."""
+        full = jnp.where(act,
+                         jnp.left_shift(jnp.int32(1), dlen) - 1, 0)
+
+        def body(carry):
+            mask, iters = carry
+            need = mask != 0
+            k0 = _low_bit_index(mask)
+            o = t + k0
+            row, found = find_run_of_order(o, need)
+            bo = ordp[:]
+            bl = lenp[:]
+            o_r = _vrow(bo, row)
+            l_r = _vrow(bl, row)
+            so = jnp.abs(o_r) - 1
+            a = o - so
+            e = jnp.minimum(l_r, t + dlen - so)
+            cov = jnp.clip(e - a, 1, dlen)  # missing orders retire 1 bit
+            # Re-check capacity per iteration: the walk splits <= 2 rows
+            # per covered run, so one fragmented delete can add far more
+            # than the +2 the op-entry check covers (review r5: a lane
+            # at CAP-2 hit by a 2-run-fragment delete would overflow and
+            # pltpu.roll would silently wrap the plane's last rows).
+            tight = rowsv[:] + 2 > CAP
+
+            @pl.when(jnp.any(need & found & tight))
+            def _cap():
+                err_ref[0:1, :] = jnp.where(need & found & tight, 1,
+                                            err_ref[0:1, :])
+
+            flip = need & found & (o_r > 0) & ~tight
+
+            has_head = (a > 0) & flip
+            has_tail = (e < l_r) & flip
+            amt = has_head.astype(jnp.int32) + has_tail.astype(jnp.int32)
+            sh_o = _vshift(bo, amt)
+            sh_l = _vshift(bl, amt)
+            no = jnp.where(idx <= row, bo, sh_o)
+            nl = jnp.where(idx <= row, bl, sh_l)
+            # Part layout: [head?] [tombstone mid] [tail?].
+            p0o = jnp.where(has_head, o_r, -(so + a + 1))
+            p0l = jnp.where(has_head, a, cov)
+            p1o = jnp.where(has_head, -(so + a + 1), so + e + 1)
+            p1l = jnp.where(has_head, cov, l_r - e)
+            w0 = flip & (idx == row)
+            no = jnp.where(w0, p0o, no)
+            nl = jnp.where(w0, p0l, nl)
+            w1 = flip & (idx == row + 1) & (amt >= 1)
+            no = jnp.where(w1, p1o, no)
+            nl = jnp.where(w1, p1l, nl)
+            w2 = flip & (idx == row + 2) & (amt == 2)
+            no = jnp.where(w2, so + e + 1, no)
+            nl = jnp.where(w2, l_r - e, nl)
+            ordp[:] = no
+            lenp[:] = nl
+            rowsv[:] = rowsv[:] + jnp.where(flip, amt, 0)
+
+            bits = jnp.left_shift(
+                jnp.left_shift(jnp.int32(1), cov) - 1, k0)
+            return jnp.where(need, mask & ~bits, mask), iters + 1
+
+        mask, _ = lax.while_loop(
+            lambda c: jnp.any(c[0] != 0) & (c[1] <= DMAX), body,
+            (full, jnp.int32(0)))
+
+        @pl.when(jnp.any(mask != 0))
+        def _bad():
+            err_ref[1:2, :] = jnp.where(mask != 0, 1, err_ref[1:2, :])
+
+    # ---- dispatch -------------------------------------------------------
+
+    def op_body(k, _):
+        kind = kind_ref[pl.ds(k, 1), :]
+        p = pos_ref[pl.ds(k, 1), :]
+        d = dlen_ref[pl.ds(k, 1), :]
+        il = ilen_ref[pl.ds(k, 1), :]
+        st = start_ref[pl.ds(k, 1), :]
+
+        act_ld = (kind == KIND_LOCAL) & (d > 0)
+        act_li = (kind == KIND_LOCAL) & (il > 0)
+        act_ri = (kind == KIND_REMOTE_INS) & (il > 0)
+        act_rd = (kind == KIND_REMOTE_DEL) & (d > 0)
+
+        @pl.when(jnp.any(act_ld))
+        def _():
+            do_local_delete(act_ld, p, d)
+
+        @pl.when(jnp.any(act_li))
+        def _():
+            do_local_insert(act_li, k, p, il, st)
+
+        @pl.when(jnp.any(act_ri))
+        def _():
+            do_remote_insert(act_ri, k, rk_ref[pl.ds(k, 1), :],
+                             olop_ref[pl.ds(k, 1), :],
+                             orop_ref[pl.ds(k, 1), :], il, st)
+
+        @pl.when(jnp.any(act_rd))
+        def _():
+            do_remote_delete(act_rd, dtgt_ref[pl.ds(k, 1), :], d)
+
+        return 0
+
+    lax.fori_loop(0, CHUNK, op_body, 0)
+
+
+@dataclasses.dataclass
+class LanesMixedResult(LanesResult):
+    """``LanesResult`` + per-lane by-order table state (the warm-start
+    carry) and the missing-order flag (err row 2)."""
+
+    oll: jax.Array = None   # i32[OCAP, B]
+    orl: jax.Array = None   # i32[OCAP, B]
+
+    def check(self) -> None:
+        super().check()
+        err = np.asarray(self.err)
+        if err[2].max() != 0:
+            raise RuntimeError(
+                f"order lookup missed on lanes "
+                f"{np.nonzero(err[2])[0][:8].tolist()}: an op referenced "
+                f"an order absent from device state")
+
+    def state(self):
+        """(ordp, lenp, rows, oll, orl) — the next chunk's ``init``."""
+        return self.ordp, self.lenp, self.rows, self.oll, self.orl
+
+
+def lane_tables(stacked: OpTensors, ocap: int):
+    """Per-lane by-order prefill: (oll, orl, rkl) as i32[OCAP, B] numpy,
+    sentinel −2 for unknown entries (−1 is ROOT).  Everything the
+    compiler knows: remote head origins, within-run chains, author
+    ranks (`batch._prefill_scatter` per lane)."""
+    kinds = np.asarray(stacked.kind)
+    assert kinds.ndim == 2, "lane_tables takes stacked [S, B] streams"
+    B = kinds.shape[1]
+    oll = np.full((B, ocap), TAB_UNKNOWN, np.int32)
+    orl = np.full((B, ocap), TAB_UNKNOWN, np.int32)
+    rkl = np.zeros((B, ocap), np.int32)
+    for b in range(B):
+        per = jax.tree.map(lambda a: np.asarray(a)[:, b], stacked)
+        sc = _prefill_scatter(per)
+        if sc is None:
+            continue
+        oll[b, sc["ol"][0]] = sc["ol"][1].astype(np.uint32).astype(
+            np.int64).astype(np.int32)  # u32 ROOT -> -1
+        orl[b, sc["or"][0]] = sc["or"][1].astype(np.uint32).astype(
+            np.int64).astype(np.int32)
+        rkl[b, sc["rank"][0]] = sc["rank"][1]
+    return (np.ascontiguousarray(oll.T), np.ascontiguousarray(orl.T),
+            np.ascontiguousarray(rkl.T))
+
+
+@functools.lru_cache(maxsize=32)
+def _build_call(s_pad: int, B: int, capacity: int, ocap: int, chunk: int,
+                dmax: int, interpret: bool, lane_tile: int | None = None):
+    """Shape-keyed cache (streaming chunks share one compiled kernel)."""
+    T = lane_tile or _lane_tile(B)
+    _require(B % T == 0, f"lane_tile {T} must divide batch {B}")
+    col = lambda: pl.BlockSpec((chunk, T), lambda lb, i: (i, lb),
+                               memory_space=pltpu.VMEM)
+    whole = lambda rows: pl.BlockSpec(
+        (rows, T), lambda lb, i: (0, lb), memory_space=pltpu.VMEM)
+
+    call = pl.pallas_call(
+        partial(_mixed_lanes_kernel, CAP=capacity, OCAP=ocap, CHUNK=chunk,
+                DMAX=dmax),
+        grid=(B // T, s_pad // chunk),
+        in_specs=[col() for _ in range(9)] + [
+            whole(capacity), whole(capacity), whole(1),
+            whole(ocap), whole(ocap),           # prior table state
+            whole(ocap), whole(ocap),           # prefill delta
+            whole(ocap),                        # ranks (read-only)
+        ],
+        out_specs=[
+            col(), col(),
+            whole(capacity), whole(capacity), whole(1),
+            whole(ocap), whole(ocap),
+            whole(8),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((s_pad, B), jnp.uint32),
+            jax.ShapeDtypeStruct((s_pad, B), jnp.uint32),
+            jax.ShapeDtypeStruct((capacity, B), jnp.int32),
+            jax.ShapeDtypeStruct((capacity, B), jnp.int32),
+            jax.ShapeDtypeStruct((1, B), jnp.int32),
+            jax.ShapeDtypeStruct((ocap, B), jnp.int32),
+            jax.ShapeDtypeStruct((ocap, B), jnp.int32),
+            jax.ShapeDtypeStruct((8, B), jnp.int32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=128 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )
+    return jax.jit(lambda *a: call(*a))
+
+
+def make_replayer_lanes_mixed(
+    ops: OpTensors,
+    capacity: int,
+    order_capacity: int = 0,
+    chunk: int = 128,
+    init=None,
+    rkl=None,
+    interpret: bool = False,
+    lane_tile: int | None = None,
+    dmax: int = 16,
+):
+    """Build a jitted per-lane MIXED replayer for stacked per-doc streams
+    (``stack_ops`` output: every column [S, B]; kinds may differ per
+    lane per step).
+
+    ``capacity`` counts run rows per document; ``order_capacity`` rows
+    of by-order table per document (0 = fit this stream: max per-lane
+    total orders, +lmax headroom).  ``init`` is a prior result's
+    ``state()`` 5-tuple — the streaming warm start; None = empty docs.
+    ``rkl`` overrides the rank table (i32[OCAP, B]; pass the host-
+    accumulated full table when chunk-chaining so earlier chunks' ranks
+    stay visible); None = this stream's prefill.
+    Remote deletes must be pre-chunked to <= ``dmax`` targets per step
+    (``compile_remote_txns(..., dmax=16)``).
+    """
+    kinds = np.asarray(ops.kind)
+    _require(kinds.ndim == 2, "rle_lanes_mixed takes stacked per-doc "
+             "streams ([S, B] columns; see batch.stack_ops)")
+    S, B = kinds.shape
+    _require(capacity >= 8, "capacity must hold a few runs")
+    dlens = np.asarray(ops.del_len)[kinds == KIND_REMOTE_DEL]
+    _require(dlens.size == 0 or int(dlens.max()) <= dmax, (
+        f"remote delete runs must be <= {dmax} targets per step "
+        f"(compile with dmax={dmax})"))
+    s_pad = max(((S + chunk - 1) // chunk) * chunk, chunk)
+
+    adv = np.asarray(ops.order_advance, dtype=np.int64).sum(axis=0)
+    base = 0
+    if init is not None and init[3] is not None:
+        base = init[3].shape[0]
+    ocap = order_capacity or max(
+        ((int(adv.max() + ops.lmax) + base + 7) // 8) * 8, 8)
+    _require(ocap % 8 == 0, "order_capacity must be a multiple of 8")
+
+    def staged_col(get):
+        a = np.asarray(get(ops), dtype=np.uint32).view(np.int32)
+        return jnp.asarray(np.pad(a, ((0, s_pad - S), (0, 0))))
+
+    staged = tuple(staged_col(g) for g in (
+        lambda o: o.kind, lambda o: o.pos, lambda o: o.del_len,
+        lambda o: o.del_target, lambda o: o.origin_left,
+        lambda o: o.origin_right, lambda o: o.rank, lambda o: o.ins_len,
+        lambda o: o.ins_order_start))
+
+    olld, orld, rkl0 = lane_tables(ops, ocap)
+    if rkl is None:
+        rkl = rkl0
+    else:
+        rkl = np.asarray(rkl, np.int32)
+        _require(rkl.shape == (ocap, B),
+                 f"rkl shape {rkl.shape} != ({ocap}, {B})")
+
+    if init is None:
+        init = (jnp.zeros((capacity, B), jnp.int32),
+                jnp.zeros((capacity, B), jnp.int32),
+                jnp.zeros((1, B), jnp.int32),
+                jnp.full((ocap, B), TAB_UNKNOWN, jnp.int32),
+                jnp.full((ocap, B), TAB_UNKNOWN, jnp.int32))
+    else:
+        o0, l0, r0, t0, t1 = init
+        _require(tuple(o0.shape) == (capacity, B),
+                 f"init state shape {o0.shape} != ({capacity}, {B})")
+        t0 = _grow_table(t0, ocap, B)
+        t1 = _grow_table(t1, ocap, B)
+        init = (jnp.asarray(o0, jnp.int32), jnp.asarray(l0, jnp.int32),
+                jnp.asarray(r0, jnp.int32).reshape(1, B), t0, t1)
+
+    jitted = _build_call(s_pad, B, capacity, ocap, chunk, dmax,
+                         interpret, lane_tile)
+    deltas = (jnp.asarray(olld), jnp.asarray(orld), jnp.asarray(rkl))
+
+    def run(state=None) -> LanesMixedResult:
+        ini = init if state is None else (
+            jnp.asarray(state[0], jnp.int32),
+            jnp.asarray(state[1], jnp.int32),
+            jnp.asarray(state[2], jnp.int32).reshape(1, B),
+            _grow_table(state[3], ocap, B),
+            _grow_table(state[4], ocap, B))
+        ol, orr, ordp, lenp, rows, oll, orl, err = jitted(
+            *staged, *ini, *deltas)
+        return LanesMixedResult(
+            ordp=ordp, lenp=lenp, rows=rows, ol=ol[:S], orr=orr[:S],
+            err=err, batch=B, oll=oll, orl=orl)
+
+    return run
+
+
+def _grow_table(t, ocap: int, B: int):
+    """Pad a prior chunk's [ocap_old, B] table up to this chunk's ocap
+    with the unknown sentinel (order spaces only grow)."""
+    t = jnp.asarray(t, jnp.int32)
+    _require(t.shape[0] <= ocap and t.shape[1] == B,
+             f"table state shape {t.shape} incompatible with "
+             f"({ocap}, {B})")
+    if t.shape[0] == ocap:
+        return t
+    pad = jnp.full((ocap - t.shape[0], B), TAB_UNKNOWN, jnp.int32)
+    return jnp.concatenate([t, pad], axis=0)
+
+
+def replay_lanes_mixed(ops: OpTensors, capacity: int,
+                       **kw) -> LanesMixedResult:
+    """One-shot convenience wrapper over ``make_replayer_lanes_mixed``."""
+    return make_replayer_lanes_mixed(ops, capacity, **kw)()

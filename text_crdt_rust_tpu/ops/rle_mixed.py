@@ -87,14 +87,25 @@ def _locate_run_raw(bo, bl, idx_k, r0, local):
     return i_r, o_r, l_r, off
 
 
-def _insert_splice_raw(bo, bl, idx_k, c, i_r, o_r, l_r, off, il, st):
+def _insert_splice_raw(bo, bl, idx_k, c, i_r, o_r, l_r, off, il, st,
+                       o_left):
     """Raw-position twin of ``rle._insert_splice``: splice a new LIVE run
     (orders ``st..st+il``) at raw position ``c`` of a block.  Differences
     from the live-rank path: the split run may be a TOMBSTONE (sign must
     be preserved on the tail: a dead run's tail starts at
     ``-(|start|+off)``), and the merge fast path additionally requires
-    the preceding run to be live (same-sign append, `span.rs:47-53`)."""
-    mrg = (c > 0) & (o_r > 0) & (off == l_r) & ((st + 1) == (o_r + l_r))
+    the preceding run to be live (same-sign append) AND the op's
+    ``origin_left`` to chain to the run's last char (`span.rs:47-53`).
+    The chain gate is load-bearing for the YATA run-skip: the scan
+    evaluates only run HEADS and skips the rest on the premise that
+    every non-head char's origin_left is its own predecessor — merging
+    an unchained run (e.g. two concurrent root inserts that happen to be
+    order-contiguous) would hide a char the scan must evaluate and
+    diverge from the oracle (caught round 5: ``amy/zed/mid`` -> ``azm``
+    instead of ``amz``)."""
+    mrg = ((c > 0) & (o_r > 0) & (off == l_r)
+           & ((st + 1) == (o_r + l_r))
+           & (o_left == o_r + l_r - 2))
     is_split = (c > 0) & (off < l_r)
     ins_at = jnp.where(c == 0, 0, i_r + 1)
     amt = jnp.where(mrg, 0, jnp.where(is_split, 2, 1))
@@ -524,7 +535,7 @@ def _mixed_rle_kernel(
         bl = lenp[pl.ds(b * K, K), :]
         i_r, o_r, l_r, off = _locate_run_raw(bo, bl, idx_k, r0, local)
         no, nl, amt, _mrg, _is_split = _insert_splice_raw(
-            bo, bl, idx_k, c, i_r, o_r, l_r, off, il, st)
+            bo, bl, idx_k, c, i_r, o_r, l_r, off, il, st, o_left)
         ordp[pl.ds(b * K, K), :] = no
         lenp[pl.ds(b * K, K), :] = nl
         rws[pl.ds(l, 1), :] = rws[pl.ds(l, 1), :] + amt
