@@ -890,7 +890,7 @@ def cfg_5_remote(args):
         for d, txns in enumerate(per_doc):
             ops, assigners[d] = B.compile_remote_txns(
                 txns, tables[d], assigner=assigners[d], lmax=lmax,
-                dmax=16)
+                dmax=None)  # one-pass interval delete: no chunking
             opses.append(ops)
             n_char_ops += sum(
                 sum(getattr(op, "len",

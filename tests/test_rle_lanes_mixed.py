@@ -29,7 +29,7 @@ from test_device_flat import oracle_from_patches, random_patches
 ROOT = RemoteId("ROOT", 0xFFFFFFFF)
 
 
-def compile_txn_lanes(lane_txns, lmax=4):
+def compile_txn_lanes(lane_txns, lmax=4, dmax=None):
     """Per-lane RemoteTxn lists -> stacked [S, B] op tensors."""
     opses = []
     for txns in lane_txns:
@@ -39,7 +39,7 @@ def compile_txn_lanes(lane_txns, lmax=4):
             for op in t.ops:
                 if hasattr(op, "id"):
                     table.add(op.id.agent)
-        ops, _ = B.compile_remote_txns(txns, table, lmax=lmax, dmax=16)
+        ops, _ = B.compile_remote_txns(txns, table, lmax=lmax, dmax=dmax)
         opses.append(ops)
     return B.stack_ops(opses)
 
@@ -320,10 +320,10 @@ class TestErrorFlags:
         with pytest.raises(RuntimeError, match="lanes \\[1\\]"):
             res.check()
 
-    def test_remote_delete_walk_capacity_flag(self):
-        # Review r5 regression: the delete walk splits +2 rows per
-        # covered run, so capacity must be re-checked INSIDE the walk —
-        # at 8 rows capacity the 4th interior delete would overflow and
+    def test_remote_delete_capacity_flag(self):
+        # Review r5 regression: a remote delete's partial-run splits add
+        # rows, so capacity is gated per op (rows + 2*npart > CAP) — at
+        # 8 rows capacity the 4th interior delete would overflow and
         # pltpu.roll would silently wrap the plane.
         txns = [RemoteTxn(id=RemoteId("amy", 0), parents=[],
                           ops=[RemoteIns(ROOT, ROOT, "aaaaaaaa")])]
@@ -352,6 +352,28 @@ class TestErrorFlags:
         stacked.del_target[0, 0] = 90
         stacked.del_len[0, 0] = 1
         stacked.ins_len[0, 0] = 0
+        res = RLM.replay_lanes_mixed(stacked, capacity=16, chunk=8,
+                                     interpret=True)
+        # The one-pass delete reports absent targets through the
+        # covered-total check (err row 1), not the order-lookup flag.
+        with pytest.raises(RuntimeError, match="past the end"):
+            res.check()
+
+    def test_missing_origin_order_flag(self):
+        # A remote insert whose origin_left order was never inserted on
+        # this lane must raise the order-lookup flag (err row 2) from
+        # the YATA scan's cursor resolution.
+        lane_txns = [[
+            RemoteTxn(id=RemoteId("a", 0), parents=[],
+                      ops=[RemoteIns(ROOT, ROOT, "ab")]),
+            RemoteTxn(id=RemoteId("a", 2), parents=[],
+                      ops=[RemoteIns(RemoteId("a", 1), ROOT, "cd")]),
+        ]]
+        stacked = compile_txn_lanes(lane_txns)
+        import jax
+
+        stacked = jax.tree.map(lambda a: np.asarray(a).copy(), stacked)
+        stacked.origin_left[1, 0] = 90  # absent order
         res = RLM.replay_lanes_mixed(stacked, capacity=16, chunk=8,
                                      interpret=True)
         with pytest.raises(RuntimeError, match="order lookup missed"):

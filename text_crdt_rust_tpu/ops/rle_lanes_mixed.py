@@ -33,12 +33,13 @@ Design — rle_lanes' lane-vector layout carried over to the remote paths:
   depth across lanes, not the sum).  The raw prefix sum the scan
   descends on is HOISTED out of the loop — the scan never mutates
   state, so one ``_vcumsum`` serves every probe of the step;
-- **run-level remote delete**: the rle_mixed bitmask walk, lane-
-  vectorized — each iteration resolves every lane's lowest unhandled
-  target order to its run (one [CAP, B] range test), splits the covered
-  sub-range out as a tombstone (<= 3 parts), and clears the covered
-  bits; already-dead runs retire their bits without flipping
-  (idempotent concurrent deletes, `double_delete.rs:6-9`).
+- **one-pass remote delete**: runs are disjoint ORDER intervals, so a
+  target range ``[t, t+dlen)`` fully covers every run it touches except
+  at most the two holding its endpoints — one interval-clip pass flips
+  the full covers and 3-way-splits the <= 2 partial runs, exactly the
+  local-delete shape keyed by orders (no fragmentation walk, no dmax
+  pre-chunking); covered DEAD runs count toward the idempotency total
+  without flipping (`double_delete.rs:6-9`).
 
 State (ordp, lenp, rows, oll, orl) is a kernel input AND output — chunk
 N+1 resumes from chunk N on device (the config-5 streaming warm start),
@@ -72,18 +73,6 @@ from .rle_lanes import LanesResult, _lane_tile, _vcumsum, _vrow, _vshift
 TAB_UNKNOWN = -2  # by-order table sentinel: entry not yet known
 
 
-def _low_bit_index(v):
-    """Per-lane floor(log2(lowest set bit)) of a [1, B] i32 vector
-    (Mosaic has no popcount; 5 shift probes cover 16-bit masks)."""
-    low = v & (-v)
-    k0 = jnp.zeros_like(v)
-    for sh in (16, 8, 4, 2, 1):
-        ge = (low >> sh) != 0
-        k0 = k0 + jnp.where(ge, sh, 0)
-        low = jnp.where(ge, low >> sh, low)
-    return k0
-
-
 def _mixed_lanes_kernel(
     kind_ref, pos_ref, dlen_ref, dtgt_ref, olop_ref, orop_ref, rk_ref,
     ilen_ref, start_ref,                        # [CHUNK, B] VMEM op columns
@@ -95,7 +84,7 @@ def _mixed_lanes_kernel(
     ordp, lenp, rowsv,                          # state outputs (working)
     oll, orl,                                   # table outputs (working)
     err_ref,
-    *, CAP: int, OCAP: int, CHUNK: int, DMAX: int,
+    *, CAP: int, OCAP: int, CHUNK: int,
 ):
     B = ordp.shape[1]
     i = pl.program_id(1)
@@ -164,6 +153,14 @@ def _mixed_lanes_kernel(
 
     def cursor_after(o, need):
         is_root = o == root_i
+        # An unknown table entry (sentinel −2) must flag, not silently
+        # resolve as order 0 (review r5).
+        unknown = need & (o == TAB_UNKNOWN)
+
+        @pl.when(jnp.any(unknown))
+        def _unk():
+            err_ref[2:3, :] = jnp.where(unknown, 1, err_ref[2:3, :])
+
         p = raw_pos_of_order(jnp.maximum(o, 0), need & ~is_root)
         return jnp.where(is_root, 0, p + 1)
 
@@ -174,6 +171,38 @@ def _mixed_lanes_kernel(
         def _cap():
             err_ref[0:1, :] = jnp.where(act & (rowsv[:] + 2 > CAP), 1,
                                         err_ref[0:1, :])
+
+    def apply_partial(a, i_p, bo, bl, cs, ce):
+        """Split run row ``i_p`` around its covered sub-range
+        ``[cs, ce)`` into [head?] [tombstone mid] [tail?] (<= +2 rows),
+        per lane where ``a``.  The signed-start fix-up covers LIVE runs
+        only (partial coverage of a dead run never reaches here)."""
+        o = _vrow(bo, i_p)
+        ln = _vrow(bl, i_p)
+        cs_i = _vrow(cs, i_p)
+        ce_i = _vrow(ce, i_p)
+        cov_i = ce_i - cs_i
+        has_head = (cs_i > 0) & a
+        has_tail = (ce_i < ln) & a
+        amt = has_head.astype(jnp.int32) + has_tail.astype(jnp.int32)
+        so = _vshift(bo, amt)
+        sl = _vshift(bl, amt)
+        no = jnp.where(idx <= i_p, bo, so)
+        nl = jnp.where(idx <= i_p, bl, sl)
+        p0o = jnp.where(has_head, o, -(o + cs_i))
+        p0l = jnp.where(has_head, cs_i, cov_i)
+        p1o = jnp.where(has_head, -(o + cs_i), o + ce_i)
+        p1l = jnp.where(has_head, cov_i, ln - ce_i)
+        w0 = a & (idx == i_p)
+        no = jnp.where(w0, p0o, no)
+        nl = jnp.where(w0, p0l, nl)
+        w1 = a & (idx == i_p + 1) & (amt >= 1)
+        no = jnp.where(w1, p1o, no)
+        nl = jnp.where(w1, p1l, nl)
+        w2 = a & (idx == i_p + 2) & (amt == 2)
+        no = jnp.where(w2, o + ce_i, no)
+        nl = jnp.where(w2, ln - ce_i, nl)
+        return no, nl, amt
 
     def do_local_delete(act, p, d):
         """Whole-doc single-pass tombstone (rle_lanes.do_delete)."""
@@ -201,36 +230,8 @@ def _mixed_lanes_kernel(
         i2 = jnp.max(jnp.where(part, idx, -1), axis=0, keepdims=True)
         bo = jnp.where(act & full, -bo, bo)
 
-        def apply_partial(a, i_p, bo, bl):
-            o = _vrow(bo, i_p)
-            ln = _vrow(bl, i_p)
-            cs_i = _vrow(cs, i_p)
-            ce_i = _vrow(ce, i_p)
-            cov_i = ce_i - cs_i
-            has_head = (cs_i > 0) & a
-            has_tail = (ce_i < ln) & a
-            amt = has_head.astype(jnp.int32) + has_tail.astype(jnp.int32)
-            so = _vshift(bo, amt)
-            sl = _vshift(bl, amt)
-            no = jnp.where(idx <= i_p, bo, so)
-            nl = jnp.where(idx <= i_p, bl, sl)
-            p0o = jnp.where(has_head, o, -(o + cs_i))
-            p0l = jnp.where(has_head, cs_i, cov_i)
-            p1o = jnp.where(has_head, -(o + cs_i), o + ce_i)
-            p1l = jnp.where(has_head, cov_i, ln - ce_i)
-            w0 = a & (idx == i_p)
-            no = jnp.where(w0, p0o, no)
-            nl = jnp.where(w0, p0l, nl)
-            w1 = a & (idx == i_p + 1) & (amt >= 1)
-            no = jnp.where(w1, p1o, no)
-            nl = jnp.where(w1, p1l, nl)
-            w2 = a & (idx == i_p + 2) & (amt == 2)
-            no = jnp.where(w2, o + ce_i, no)
-            nl = jnp.where(w2, ln - ce_i, nl)
-            return no, nl, amt
-
-        bo, bl, a2 = apply_partial(act & (npart >= 1), i2, bo, bl)
-        bo, bl, a1 = apply_partial(act & (npart == 2), i1, bo, bl)
+        bo, bl, a2 = apply_partial(act & (npart >= 1), i2, bo, bl, cs, ce)
+        bo, bl, a1 = apply_partial(act & (npart == 2), i1, bo, bl, cs, ce)
         ordp[:] = bo
         lenp[:] = bl
         rowsv[:] = rowsv[:] + jnp.where(act, a1 + a2, 0)
@@ -401,77 +402,55 @@ def _mixed_lanes_kernel(
     # ---- remote delete (`doc.rs:295-340`) -------------------------------
 
     def do_remote_delete(act, t, dlen):
-        """Per-lane bitmask walk over the <= DMAX-long target range: each
-        iteration retires every lane's lowest unhandled order.  Capacity
-        is checked inside the walk (each covered run can split +2 rows),
-        not at op entry."""
-        full = jnp.where(act,
-                         jnp.left_shift(jnp.int32(1), dlen) - 1, 0)
+        """Order-interval tombstone in ONE pass (`doc.rs:295-340`
+        without the fragmentation walk): runs are disjoint order
+        intervals, so at most TWO covered runs are partial — the ones
+        holding ``t`` and ``t+dlen-1`` — and every other covered run is
+        fully inside ``[t, t+dlen)`` and flips wholesale.  Same shape as
+        the local delete, keyed by ORDERS instead of live ranks; covered
+        DEAD runs just count toward the idempotency total without
+        flipping (`double_delete.rs:6-9`; excess counting is host-side
+        per SURVEY).  Any ``dlen`` in one step — no dmax pre-chunking."""
+        bo = ordp[:]
+        bl = lenp[:]
+        so = jnp.abs(bo) - 1
+        occ = bo != 0
+        cs = jnp.clip(t - so, 0, bl)
+        ce = jnp.clip(t + dlen - so, 0, bl)
+        cov = jnp.where(act & occ, ce - cs, 0)
+        tot = jnp.sum(cov, axis=0, keepdims=True)
+        rem = jnp.where(act, dlen, 0)
 
-        def body(carry):
-            mask, iters = carry
-            need = mask != 0
-            k0 = _low_bit_index(mask)
-            o = t + k0
-            row, found = find_run_of_order(o, need)
-            bo = ordp[:]
-            bl = lenp[:]
-            o_r = _vrow(bo, row)
-            l_r = _vrow(bl, row)
-            so = jnp.abs(o_r) - 1
-            a = o - so
-            e = jnp.minimum(l_r, t + dlen - so)
-            cov = jnp.clip(e - a, 1, dlen)  # missing orders retire 1 bit
-            # Re-check capacity per iteration: the walk splits <= 2 rows
-            # per covered run, so one fragmented delete can add far more
-            # than the +2 the op-entry check covers (review r5: a lane
-            # at CAP-2 hit by a 2-run-fragment delete would overflow and
-            # pltpu.roll would silently wrap the plane's last rows).
-            tight = rowsv[:] + 2 > CAP
-
-            @pl.when(jnp.any(need & found & tight))
-            def _cap():
-                err_ref[0:1, :] = jnp.where(need & found & tight, 1,
-                                            err_ref[0:1, :])
-
-            flip = need & found & (o_r > 0) & ~tight
-
-            has_head = (a > 0) & flip
-            has_tail = (e < l_r) & flip
-            amt = has_head.astype(jnp.int32) + has_tail.astype(jnp.int32)
-            sh_o = _vshift(bo, amt)
-            sh_l = _vshift(bl, amt)
-            no = jnp.where(idx <= row, bo, sh_o)
-            nl = jnp.where(idx <= row, bl, sh_l)
-            # Part layout: [head?] [tombstone mid] [tail?].
-            p0o = jnp.where(has_head, o_r, -(so + a + 1))
-            p0l = jnp.where(has_head, a, cov)
-            p1o = jnp.where(has_head, -(so + a + 1), so + e + 1)
-            p1l = jnp.where(has_head, cov, l_r - e)
-            w0 = flip & (idx == row)
-            no = jnp.where(w0, p0o, no)
-            nl = jnp.where(w0, p0l, nl)
-            w1 = flip & (idx == row + 1) & (amt >= 1)
-            no = jnp.where(w1, p1o, no)
-            nl = jnp.where(w1, p1l, nl)
-            w2 = flip & (idx == row + 2) & (amt == 2)
-            no = jnp.where(w2, so + e + 1, no)
-            nl = jnp.where(w2, l_r - e, nl)
-            ordp[:] = no
-            lenp[:] = nl
-            rowsv[:] = rowsv[:] + jnp.where(flip, amt, 0)
-
-            bits = jnp.left_shift(
-                jnp.left_shift(jnp.int32(1), cov) - 1, k0)
-            return jnp.where(need, mask & ~bits, mask), iters + 1
-
-        mask, _ = lax.while_loop(
-            lambda c: jnp.any(c[0] != 0) & (c[1] <= DMAX), body,
-            (full, jnp.int32(0)))
-
-        @pl.when(jnp.any(mask != 0))
+        @pl.when(jnp.any(act & (tot < rem)))
         def _bad():
-            err_ref[1:2, :] = jnp.where(mask != 0, 1, err_ref[1:2, :])
+            err_ref[1:2, :] = jnp.where(act & (tot < rem), 1,
+                                        err_ref[1:2, :])
+
+        live = bo > 0
+        full = live & (cov > 0) & (cov == bl)
+        part = live & (cov > 0) & jnp.logical_not(cov == bl)
+        npart = jnp.sum(part.astype(jnp.int32), axis=0, keepdims=True)
+        # Max growth is +2 per op: one run holding both endpoints 3-way
+        # splits (+2), or the two endpoint runs each split one-sided
+        # (+1 each).  Gate BOTH splits and the full flips so a flagged
+        # delete is a clean no-op (review r5: overflow would let
+        # pltpu.roll silently wrap the plane).
+        tight = act & (npart > 0) & (rowsv[:] + 2 > CAP)
+
+        @pl.when(jnp.any(tight))
+        def _cap():
+            err_ref[0:1, :] = jnp.where(tight, 1, err_ref[0:1, :])
+
+        a = act & ~tight
+        i1 = jnp.min(jnp.where(part, idx, CAP), axis=0, keepdims=True)
+        i2 = jnp.max(jnp.where(part, idx, -1), axis=0, keepdims=True)
+        bo = jnp.where(a & full, -bo, bo)
+
+        bo, bl, a2 = apply_partial(a & (npart >= 1), i2, bo, bl, cs, ce)
+        bo, bl, a1 = apply_partial(a & (npart == 2), i1, bo, bl, cs, ce)
+        ordp[:] = bo
+        lenp[:] = bl
+        rowsv[:] = rowsv[:] + jnp.where(a, a1 + a2, 0)
 
     # ---- dispatch -------------------------------------------------------
 
@@ -559,7 +538,7 @@ def lane_tables(stacked: OpTensors, ocap: int):
 
 @functools.lru_cache(maxsize=32)
 def _build_call(s_pad: int, B: int, capacity: int, ocap: int, chunk: int,
-                dmax: int, interpret: bool, lane_tile: int | None = None):
+                interpret: bool, lane_tile: int | None = None):
     """Shape-keyed cache (streaming chunks share one compiled kernel)."""
     T = lane_tile or _lane_tile(B)
     _require(B % T == 0, f"lane_tile {T} must divide batch {B}")
@@ -569,8 +548,8 @@ def _build_call(s_pad: int, B: int, capacity: int, ocap: int, chunk: int,
         (rows, T), lambda lb, i: (0, lb), memory_space=pltpu.VMEM)
 
     call = pl.pallas_call(
-        partial(_mixed_lanes_kernel, CAP=capacity, OCAP=ocap, CHUNK=chunk,
-                DMAX=dmax),
+        partial(_mixed_lanes_kernel, CAP=capacity, OCAP=ocap,
+                CHUNK=chunk),
         grid=(B // T, s_pad // chunk),
         in_specs=[col() for _ in range(9)] + [
             whole(capacity), whole(capacity), whole(1),
@@ -611,7 +590,6 @@ def make_replayer_lanes_mixed(
     rkl=None,
     interpret: bool = False,
     lane_tile: int | None = None,
-    dmax: int = 16,
 ):
     """Build a jitted per-lane MIXED replayer for stacked per-doc streams
     (``stack_ops`` output: every column [S, B]; kinds may differ per
@@ -623,19 +601,15 @@ def make_replayer_lanes_mixed(
     ``state()`` 5-tuple — the streaming warm start; None = empty docs.
     ``rkl`` overrides the rank table (i32[OCAP, B]; pass the host-
     accumulated full table when chunk-chaining so earlier chunks' ranks
-    stay visible); None = this stream's prefill.
-    Remote deletes must be pre-chunked to <= ``dmax`` targets per step
-    (``compile_remote_txns(..., dmax=16)``).
+    stay visible); None = this stream's prefill.  Remote deletes of any
+    length apply in one step (the one-pass interval delete needs no
+    dmax pre-chunking).
     """
     kinds = np.asarray(ops.kind)
     _require(kinds.ndim == 2, "rle_lanes_mixed takes stacked per-doc "
              "streams ([S, B] columns; see batch.stack_ops)")
     S, B = kinds.shape
     _require(capacity >= 8, "capacity must hold a few runs")
-    dlens = np.asarray(ops.del_len)[kinds == KIND_REMOTE_DEL]
-    _require(dlens.size == 0 or int(dlens.max()) <= dmax, (
-        f"remote delete runs must be <= {dmax} targets per step "
-        f"(compile with dmax={dmax})"))
     s_pad = max(((S + chunk - 1) // chunk) * chunk, chunk)
 
     adv = np.asarray(ops.order_advance, dtype=np.int64).sum(axis=0)
@@ -679,7 +653,7 @@ def make_replayer_lanes_mixed(
         init = (jnp.asarray(o0, jnp.int32), jnp.asarray(l0, jnp.int32),
                 jnp.asarray(r0, jnp.int32).reshape(1, B), t0, t1)
 
-    jitted = _build_call(s_pad, B, capacity, ocap, chunk, dmax,
+    jitted = _build_call(s_pad, B, capacity, ocap, chunk,
                          interpret, lane_tile)
     deltas = (jnp.asarray(olld), jnp.asarray(orld), jnp.asarray(rkl))
 
