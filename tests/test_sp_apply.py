@@ -216,3 +216,207 @@ class TestSpApply:
         doc = sp_doc(shard_rows=32)
         with pytest.raises(RuntimeError, match="end of the document"):
             apply_patches(doc, [TestPatch(0, 0, "ab"), TestPatch(0, 5, "")])
+
+    def test_auto_reshard_on_capacity(self):
+        # Phase 1 packs 6 runs into shard 0 (a fresh SpDoc owns every
+        # rank there).  Phase 2's spread inserts would overflow shard
+        # 0's 8-row budget; with auto_reshard the capacity flag
+        # triggers an even rebalance + one retry, after which the same
+        # stream's inserts land on different shards and fit (VERDICT r4
+        # next #8).  The retry replays from the pre-stream state, so
+        # the final doc must still equal the full-history simulation.
+        mesh = make_mesh(sp=8)
+        doc = SpDoc(mesh, 8, auto_reshard=True)
+        p1, content = [], ""
+        for k in range(6):  # alternate ends so runs can't merge
+            pos = 0 if k % 2 else len(content)
+            p1.append(TestPatch(pos, 0, "ab"))
+            content = content[:pos] + "ab" + content[pos:]
+        _, nxt = apply_patches(doc, p1)
+        assert int(np.asarray(doc.rows)[0]) >= 5  # all packed in shard 0
+        p2 = [TestPatch(pos, 0, "Q") for pos in (1, 3, 5, 7, 9, 11)]
+        apply_patches(doc, p2, start_order=nxt)
+        np.testing.assert_array_equal(doc.expand(), sim_flat(p1 + p2))
+        # Capacity without auto_reshard must still raise.
+        doc2 = SpDoc(mesh, 8)
+        with pytest.raises(RuntimeError, match="capacity"):
+            apply_patches(doc2, p1 + p2)
+
+
+def oracle_signed(oracle):
+    return [(-1 if oracle.deleted[i] else 1) * (int(oracle.order[i]) + 1)
+            for i in range(oracle.n)]
+
+
+def compile_remote(txns, lmax=4):
+    table = B.AgentTable()
+    for t in txns:
+        table.add(t.id.agent)
+        for op in t.ops:
+            if hasattr(op, "id"):
+                table.add(op.id.agent)
+    ops, _ = B.compile_remote_txns(txns, table, lmax=lmax, dmax=None)
+    return ops
+
+
+class TestSpRemote:
+    """Sharded REMOTE integrate + delete (r4 verdict missing #4): the
+    sp-sharded apply must equal the oracle and the single-device
+    ``ops.rle_mixed`` engine on the same streams."""
+
+    def _oracle(self, txns):
+        from text_crdt_rust_tpu.models.oracle import ListCRDT
+        doc = ListCRDT()
+        for t in txns:
+            doc.apply_remote_txn(t)
+        return doc
+
+    def test_concurrent_root_inserts_tiebreak(self):
+        from text_crdt_rust_tpu.common import RemoteId, RemoteIns, RemoteTxn
+        ROOT = RemoteId("ROOT", 0xFFFFFFFF)
+        txns = [
+            RemoteTxn(id=RemoteId(n, 0), parents=[],
+                      ops=[RemoteIns(ROOT, ROOT, t)])
+            for n, t in [("zed", "zz"), ("amy", "aa"), ("mia", "mm")]
+        ]
+        doc = sp_doc(shard_rows=16)
+        doc.apply_stream(compile_remote(txns))
+        assert doc.expand().tolist() == oracle_signed(self._oracle(txns))
+
+    def test_order_contiguous_unchained_no_merge(self):
+        # The round-5 merge-chain regression, sharded: zed's char must
+        # not merge into amy's run (origin_left is ROOT, not amy).
+        from text_crdt_rust_tpu.common import RemoteId, RemoteIns, RemoteTxn
+        ROOT = RemoteId("ROOT", 0xFFFFFFFF)
+        txns = [
+            RemoteTxn(id=RemoteId(n, 0), parents=[],
+                      ops=[RemoteIns(ROOT, ROOT, t)])
+            for n, t in [("amy", "a"), ("zed", "z"), ("mid", "m")]
+        ]
+        doc = sp_doc(shard_rows=16)
+        doc.apply_stream(compile_remote(txns))
+        oracle = self._oracle(txns)
+        assert oracle.to_string() == "amz"
+        assert doc.expand().tolist() == oracle_signed(oracle)
+
+    def test_fragmented_and_double_delete(self):
+        from text_crdt_rust_tpu.common import (
+            RemoteDel, RemoteId, RemoteIns, RemoteTxn)
+        ROOT = RemoteId("ROOT", 0xFFFFFFFF)
+        txns = [
+            RemoteTxn(id=RemoteId("amy", 0), parents=[],
+                      ops=[RemoteIns(ROOT, ROOT, "abcdef")]),
+            RemoteTxn(id=RemoteId("bob", 0), parents=[RemoteId("amy", 5)],
+                      ops=[RemoteDel(RemoteId("amy", 1), 3)]),
+            RemoteTxn(id=RemoteId("cat", 0), parents=[RemoteId("amy", 5)],
+                      ops=[RemoteDel(RemoteId("amy", 2), 3)]),
+            RemoteTxn(id=RemoteId("bob", 3), parents=[RemoteId("amy", 5)],
+                      ops=[RemoteIns(RemoteId("amy", 2),
+                                     RemoteId("amy", 3), "XY")]),
+        ]
+        doc = sp_doc(shard_rows=16)
+        doc.apply_stream(compile_remote(txns))
+        assert doc.expand().tolist() == oracle_signed(self._oracle(txns))
+
+    @pytest.mark.parametrize("seed", [3, 21])
+    def test_two_peer_merge_matches_rle_mixed(self, seed):
+        # The VERDICT bar: sp-sharded remote apply equal to the
+        # single-device rle_mixed engine's output on the same stream.
+        from text_crdt_rust_tpu.models.sync import export_txns_since
+        from text_crdt_rust_tpu.ops import rle as R
+        from text_crdt_rust_tpu.ops import rle_mixed as RM
+        from text_crdt_rust_tpu.ops import span_arrays as SA
+        from test_device_flat import oracle_from_patches, random_patches
+
+        rng = random.Random(seed)
+        pa, _ = random_patches(rng, 30)
+        pb, _ = random_patches(rng, 30)
+        a = oracle_from_patches(pa, agent="peer-a")
+        b = oracle_from_patches(pb, agent="peer-b")
+        txns = export_txns_since(a, 0) + export_txns_since(b, 0)
+        # rle_mixed needs dmax-chunked deletes; recompile for it.
+        table = B.AgentTable()
+        for t in txns:
+            table.add(t.id.agent)
+            for op in t.ops:
+                if hasattr(op, "id"):
+                    table.add(op.id.agent)
+        ops_rm, _ = B.compile_remote_txns(txns, table, lmax=4, dmax=16)
+        res = RM.replay_mixed_rle(ops_rm, capacity=512, batch=8,
+                                  block_k=8, chunk=128, interpret=True)
+        flat = R.rle_to_flat(ops_rm, res)
+        cols = SA.download(flat)
+        want = [(-1 if cols["deleted"][i] else 1)
+                * (int(cols["order"][i]) + 1)
+                for i in range(len(cols["order"]))]
+
+        # Streamed in chunks with auto_reshard: a fresh SpDoc packs
+        # every rank into shard 0; the between-chunk rebalance spreads
+        # the rows so later chunks' probes cross shards for real.
+        mesh = make_mesh(sp=8)
+        # One 10-txn chunk can add ~50 rows to a single shard (a fresh
+        # doc owns every rank in shard 0); 128 gives the pre-rebalance
+        # buildup room while still forcing a mid-history rebalance.
+        doc = SpDoc(mesh, 128, auto_reshard=True)
+        table2 = B.AgentTable()
+        for t in txns:
+            table2.add(t.id.agent)
+            for op in t.ops:
+                if hasattr(op, "id"):
+                    table2.add(op.id.agent)
+        assigner = None
+        for at in range(0, len(txns), 10):
+            ops_c, assigner = B.compile_remote_txns(
+                txns[at:at + 10], table2, assigner=assigner, lmax=4,
+                dmax=None)
+            doc.apply_stream(ops_c)
+        assert doc.expand().tolist() == want
+        assert want == oracle_signed(self._oracle(txns))
+
+    def test_mixed_local_then_remote_stream(self):
+        # Local ops and remote ops in ONE stream (all four dispatch
+        # branches), vs the oracle applying the same logical edits.
+        from text_crdt_rust_tpu.common import RemoteId, RemoteIns, RemoteTxn
+        from text_crdt_rust_tpu.models.oracle import ListCRDT
+        ROOT = RemoteId("ROOT", 0xFFFFFFFF)
+
+        oracle = ListCRDT()
+        me = oracle.get_or_create_agent_id("me")
+        oracle.local_insert(me, 0, "hello world")
+        oracle.local_delete(me, 2, 3)
+        txn = RemoteTxn(id=RemoteId("peer", 0), parents=[],
+                        ops=[RemoteIns(ROOT, ROOT, "Q")])
+        oracle.apply_remote_txn(txn)
+
+        ops_local, nxt = B.compile_local_patches(
+            [TestPatch(0, 0, "hello world"), TestPatch(2, 3, "")],
+            lmax=16, dmax=None)
+        table = B.AgentTable(["me", "peer"])
+        assigner = B.OrderAssigner(table)
+        assigner.assign(table.id_of("me"), 0, nxt)
+        ops_remote, _ = B.compile_remote_txns([txn], table,
+                                              assigner=assigner,
+                                              lmax=16, dmax=None)
+        import jax as _jax
+        combined = _jax.tree.map(
+            lambda x, y: np.concatenate([np.asarray(x), np.asarray(y)]),
+            ops_local, ops_remote)
+        doc = sp_doc(shard_rows=32)
+        doc.apply_stream(combined)
+        assert doc.expand().tolist() == oracle_signed(oracle)
+
+    def test_missing_order_raises(self):
+        from text_crdt_rust_tpu.common import (
+            RemoteDel, RemoteId, RemoteIns, RemoteTxn)
+        ROOT = RemoteId("ROOT", 0xFFFFFFFF)
+        txns = [RemoteTxn(id=RemoteId("a", 0), parents=[],
+                          ops=[RemoteIns(ROOT, ROOT, "ab")]),
+                RemoteTxn(id=RemoteId("a", 2), parents=[],
+                          ops=[RemoteIns(RemoteId("a", 1), ROOT, "cd")])]
+        ops = compile_remote(txns)
+        import jax as _jax
+        ops = _jax.tree.map(lambda a: np.asarray(a).copy(), ops)
+        ops.origin_left[1] = 90  # absent order
+        doc = sp_doc(shard_rows=16)
+        with pytest.raises(RuntimeError, match="order lookup missed"):
+            doc.apply_stream(ops)

@@ -1,0 +1,8 @@
+"""jax version compatibility shared by the parallel modules."""
+try:  # jax >= 0.8: top-level shard_map, check_rep -> check_vma
+    from jax import shard_map as _jax_shard_map
+
+    def shard_map(f=None, *, check_rep=True, **kw):
+        return _jax_shard_map(f, check_vma=check_rep, **kw)
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map  # noqa: F401
