@@ -29,6 +29,10 @@ from test_device_flat import (
     random_patches,
 )
 
+# Superseded per-char engine: differential reference only; excluded
+# from the default run (see pytest.ini / README engine lineup).
+pytestmark = pytest.mark.archival
+
 ROOT = RemoteId("ROOT", 0xFFFFFFFF)
 
 

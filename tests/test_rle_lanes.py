@@ -133,6 +133,37 @@ class TestDivergentLanes:
             got = "".join(chars[int(o) - 1] for o in flat if o > 0)
             assert got == contents[d], f"doc {d} diverged after warm start"
 
+    def test_warm_start_capacity_growth(self):
+        # Streaming chunks may GROW row capacity (the round-5 bench
+        # lever): chunk 2 at a larger capacity must zero-pad chunk 1's
+        # planes and produce the same state as a flat-capacity chain.
+        rng = random.Random(31)
+        docs = 4
+        streams1 = [random_patches(rng, 15)[0] for _ in range(docs)]
+        stacked1, nexts = compile_stack(streams1)
+        small = RL.make_replayer_lanes(stacked1, capacity=64, chunk=8,
+                                       interpret=True)()
+        small.check()
+        streams2 = [random_patches(rng, 15)[0] for _ in range(docs)]
+        opses = [B.compile_local_patches(ps, lmax=16, dmax=None,
+                                         start_order=nx)[0]
+                 for ps, nx in zip(streams2, nexts)]
+        stacked2 = B.stack_ops(opses)
+        grown = RL.make_replayer_lanes(stacked2, capacity=128, chunk=8,
+                                       interpret=True)(small.state())
+        grown.check()
+
+        flat1 = RL.make_replayer_lanes(stacked1, capacity=128, chunk=8,
+                                       interpret=True)()
+        flat2 = RL.make_replayer_lanes(stacked2, capacity=128, chunk=8,
+                                       interpret=True)(flat1.state())
+        assert np.array_equal(np.asarray(grown.ordp),
+                              np.asarray(flat2.ordp))
+        assert np.array_equal(np.asarray(grown.lenp),
+                              np.asarray(flat2.lenp))
+        assert np.array_equal(np.asarray(grown.rows),
+                              np.asarray(flat2.rows))
+
     def test_capacity_flag_per_lane(self):
         # Lane 1 overflows a tiny capacity; lane 0 stays legal.
         streams = [

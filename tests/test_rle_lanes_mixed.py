@@ -293,6 +293,51 @@ class TestWarmStartChaining:
                     == oracle.to_string()), f"lane {d}"
 
 
+class TestCapacityGrowth:
+    def test_remote_chunks_grow_capacity(self):
+        # Chunked remote streaming with GROWING row + order capacities
+        # (the round-5 bench lever) must equal the flat-capacity chain.
+        rng = random.Random(77)
+        docs = 3
+        peers = [oracle_from_patches(random_patches(rng, 30)[0],
+                                     agent=f"p{d}") for d in range(docs)]
+        lane_txns = [export_txns_since(p, 0) for p in peers]
+        halves = [(t[: len(t) // 2], t[len(t) // 2:]) for t in lane_txns]
+
+        def compile_chunk(which, tables, assigners):
+            opses = []
+            for d in range(docs):
+                for t in halves[d][which]:
+                    tables[d].add(t.id.agent)
+                ops, assigners[d] = B.compile_remote_txns(
+                    halves[d][which], tables[d], assigner=assigners[d],
+                    lmax=4, dmax=None)
+                opses.append(ops)
+            return B.stack_ops(opses)
+
+        def chain(caps, ocaps):
+            tables = [B.AgentTable() for _ in range(docs)]
+            assigners = [None] * docs
+            c0 = compile_chunk(0, tables, assigners)
+            r0 = RLM.make_replayer_lanes_mixed(
+                c0, capacity=caps[0], order_capacity=ocaps[0], chunk=16,
+                interpret=True)()
+            r0.check()
+            c1 = compile_chunk(1, tables, assigners)
+            r1 = RLM.make_replayer_lanes_mixed(
+                c1, capacity=caps[1], order_capacity=ocaps[1], chunk=16,
+                init=r0.state(), interpret=True)()
+            r1.check()
+            return r1
+
+        grown = chain((64, 128), (64, 128))
+        flat = chain((128, 128), (128, 128))
+        for f in ("ordp", "lenp", "rows"):
+            a = np.asarray(getattr(grown, f))
+            b = np.asarray(getattr(flat, f))
+            assert np.array_equal(a, b[: a.shape[0]]), f
+
+
 class TestErrorFlags:
     def test_capacity_flag_per_lane(self):
         lane_txns = [

@@ -367,22 +367,35 @@ def make_replayer_lanes(
                 jnp.zeros((capacity, B), jnp.int32),
                 jnp.zeros((1, B), jnp.int32))
     else:
-        o0, l0, r0 = init
-        _require(tuple(o0.shape) == (capacity, B),
-                 f"init state shape {o0.shape} != ({capacity}, {B})")
-        init = (jnp.asarray(o0, jnp.int32), jnp.asarray(l0, jnp.int32),
-                jnp.asarray(r0, jnp.int32).reshape(1, B))
+        init = _grow_planes(init, capacity, B)
 
     jitted = _build_call(s_pad, B, capacity, chunk, interpret, lane_tile)
 
     def run(state=None) -> LanesResult:
-        ini = init if state is None else (
-            state[0], state[1], state[2].reshape(1, B))
+        ini = init if state is None else _grow_planes(state, capacity, B)
         ol, orr, ordp, lenp, rows, err = jitted(*staged, *ini)
         return LanesResult(ordp=ordp, lenp=lenp, rows=rows,
                            ol=ol[:S], orr=orr[:S], err=err, batch=B)
 
     return run
+
+
+def _grow_planes(state, capacity: int, B: int):
+    """Zero-pad a prior chunk's (ordp, lenp, rows) up to this chunk's
+    row capacity (run rows pack at the front, so padding is free) —
+    streaming chunks may GROW capacity as documents accumulate rows
+    instead of paying the final capacity from chunk 0."""
+    o0, l0, r0 = state
+    o0 = jnp.asarray(o0, jnp.int32)
+    l0 = jnp.asarray(l0, jnp.int32)
+    _require(o0.shape[0] <= capacity and o0.shape[1] == B,
+             f"init state shape {o0.shape} incompatible with "
+             f"({capacity}, {B})")
+    if o0.shape[0] < capacity:
+        pad = jnp.zeros((capacity - o0.shape[0], B), jnp.int32)
+        o0 = jnp.concatenate([o0, pad], axis=0)
+        l0 = jnp.concatenate([l0, pad], axis=0)
+    return (o0, l0, jnp.asarray(r0, jnp.int32).reshape(1, B))
 
 
 def replay_lanes(ops: OpTensors, capacity: int, **kw) -> LanesResult:

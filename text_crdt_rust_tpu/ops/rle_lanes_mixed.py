@@ -645,25 +645,15 @@ def make_replayer_lanes_mixed(
                 jnp.full((ocap, B), TAB_UNKNOWN, jnp.int32),
                 jnp.full((ocap, B), TAB_UNKNOWN, jnp.int32))
     else:
-        o0, l0, r0, t0, t1 = init
-        _require(tuple(o0.shape) == (capacity, B),
-                 f"init state shape {o0.shape} != ({capacity}, {B})")
-        t0 = _grow_table(t0, ocap, B)
-        t1 = _grow_table(t1, ocap, B)
-        init = (jnp.asarray(o0, jnp.int32), jnp.asarray(l0, jnp.int32),
-                jnp.asarray(r0, jnp.int32).reshape(1, B), t0, t1)
+        init = _grow_state(init, capacity, ocap, B)
 
     jitted = _build_call(s_pad, B, capacity, ocap, chunk,
                          interpret, lane_tile)
     deltas = (jnp.asarray(olld), jnp.asarray(orld), jnp.asarray(rkl))
 
     def run(state=None) -> LanesMixedResult:
-        ini = init if state is None else (
-            jnp.asarray(state[0], jnp.int32),
-            jnp.asarray(state[1], jnp.int32),
-            jnp.asarray(state[2], jnp.int32).reshape(1, B),
-            _grow_table(state[3], ocap, B),
-            _grow_table(state[4], ocap, B))
+        ini = init if state is None else _grow_state(
+            state, capacity, ocap, B)
         ol, orr, ordp, lenp, rows, oll, orl, err = jitted(
             *staged, *ini, *deltas)
         return LanesMixedResult(
@@ -671,6 +661,18 @@ def make_replayer_lanes_mixed(
             err=err, batch=B, oll=oll, orl=orl)
 
     return run
+
+
+def _grow_state(state, capacity: int, ocap: int, B: int):
+    """Pad a prior chunk's state 5-tuple up to this chunk's row/order
+    capacities (rows pack at the front; tables are order-indexed) —
+    streaming chunks may GROW both as documents accumulate."""
+    from .rle_lanes import _grow_planes
+
+    o0, l0, r0 = _grow_planes(state[:3], capacity, B)
+    return (o0, l0, r0,
+            _grow_table(state[3], ocap, B),
+            _grow_table(state[4], ocap, B))
 
 
 def _grow_table(t, ocap: int, B: int):
