@@ -667,6 +667,25 @@ def cfg_4(args):
     # Suite-wide --engine values cfg_4 doesn't distinguish (rle-hbm,
     # blocked, ...) fall back to the default run engine rather than
     # failing the whole config.
+    def run_storm_rle_mixed(config, ops_, want_, n_ops_, base_ops_,
+                            batch4, **extra):
+        """One rle-mixed storm measurement -> a bench row (shared by the
+        insert storm and the delete-heavy variant so the capacity
+        heuristic and replayer kwargs cannot drift apart)."""
+        # Run capacity: every storm op splices <= 3 rows; 2x headroom.
+        block_k = 128
+        capacity = ((max(int(ops_.num_steps * 3), 256) + block_k - 1)
+                    // block_k) * block_k
+        run = RM.make_replayer_rle_mixed(
+            ops_, capacity=capacity, batch=batch4, block_k=block_k,
+            chunk=128 if args.smoke else 1024, interpret=args.interpret)
+        res, wall, dist = time_run(run, args.reps)
+        got = SA.to_string(R.rle_to_flat(ops_, res))
+        return make_row(config, "rle-mixed", n_ops_, batch4, wall,
+                        ops_.num_steps, 2 * capacity * batch4 * 4,
+                        base_ops_, got == want_,
+                        peers=n_peers, rounds=rounds, **extra, **dist)
+
     if args.engine == "blocked-mixed":
         # The per-char blocked engine is VMEM-bound at 128 lanes.
         batch4 = min(args.batch, 128) if args.batch else 128
@@ -676,28 +695,40 @@ def cfg_4(args):
                                      block_k=block_k,
                                      chunk=128 if args.smoke else 1024,
                                      interpret=args.interpret)
-        engine, to_flat = "blocked-mixed", BL.blocked_to_flat
-    else:
-        # The run engine's planes (~9.6k rows) fit 512 lanes — and its
-        # step cost is dominated by lane-independent sequencing (scalar
-        # table reads, lane reductions), so wider batches are nearly
-        # free.
-        batch4 = args.batch or 128
-        # Run capacity: every storm op splices <= 3 rows; 2x headroom.
-        n_steps_cap = max(int(ops.num_steps * 3), 256)
-        block_k = 128
-        capacity = ((n_steps_cap + block_k - 1) // block_k) * block_k
-        run = RM.make_replayer_rle_mixed(
-            ops, capacity=capacity, batch=batch4, block_k=block_k,
-            chunk=128 if args.smoke else 1024, interpret=args.interpret)
-        engine, to_flat = "rle-mixed", R.rle_to_flat
-    hbm = 2 * capacity * batch4 * 4
-    res, wall, dist = time_run(run, args.reps)
-    got = SA.to_string(to_flat(ops, res))
-    return make_row("config4_concurrent_insert_storm", engine,
-                    total_chars, batch4, wall, ops.num_steps, hbm,
-                    base_ops, got == want,
-                    peers=n_peers, rounds=rounds, **dist)
+        res, wall, dist = time_run(run, args.reps)
+        got = SA.to_string(BL.blocked_to_flat(ops, res))
+        return make_row("config4_concurrent_insert_storm",
+                        "blocked-mixed", total_chars, batch4, wall,
+                        ops.num_steps, 2 * capacity * batch4 * 4,
+                        base_ops, got == want,
+                        peers=n_peers, rounds=rounds, **dist)
+
+    # The run engine's planes (~9.6k rows) fit 512 lanes — and its step
+    # cost is dominated by lane-independent sequencing (scalar table
+    # reads, lane reductions), so wider batches are nearly free.
+    batch4 = args.batch or 128
+    row = run_storm_rle_mixed("config4_concurrent_insert_storm", ops,
+                              want, total_chars, base_ops, batch4)
+
+    # Delete-heavy remote variant (VERDICT r4 next #3: the remote
+    # delete path — fragmentation walk, double deletes — had never
+    # been benched): ~35% of peer rounds merge earlier history and
+    # delete a cross-peer span instead of inserting.
+    dtxns, dreceiver = make_storm(n_peers, rounds, run_len, seed=7,
+                                  del_prob=0.35)
+    dwant = dreceiver.to_string()
+    dbase_ops, dbase_str = native_remote_replay(dtxns)
+    assert dbase_str == dwant
+    dtable = B.AgentTable(sorted({t.id.agent for t in dtxns}))
+    dops, _ = B.compile_remote_txns(dtxns, dtable,
+                                    lmax=min(16, run_len * 2), dmax=16)
+    d_chars = sum(sum(getattr(op, "len",
+                              len(getattr(op, "ins_content", "")))
+                      for op in t.ops) for t in dtxns)
+    drow = run_storm_rle_mixed("config4_delete_heavy_storm", dops,
+                               dwant, d_chars, dbase_ops, batch4,
+                               del_prob=0.35)
+    return [row, drow]
 
 
 def _stream_loop(runners, resync_every, ckpt_path, state_keys):

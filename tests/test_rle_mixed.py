@@ -193,6 +193,19 @@ class TestMixedRleRemote:
         assert SA.to_string(doc) == receiver.to_string()
         assert SA.doc_spans(doc) == oracle.doc_spans()
 
+    def test_config4_delete_heavy_storm_oracle(self):
+        # The bench delete-heavy variant: peers merge earlier rounds
+        # and delete cross-peer spans (remote deletes, double deletes)
+        # between the concurrent inserts.
+        txns, receiver = make_storm(4, 8, 3, seed=7, del_prob=0.4)
+        kinds = {type(op).__name__ for t in txns for op in t.ops}
+        assert "RemoteDel" in kinds, "variant generated no deletes"
+        oracle = oracle_txns(txns)
+        assert oracle.to_string() == receiver.to_string()
+        doc = replay_txns(txns, capacity=1024, block_k=8, lmax=8)
+        assert SA.to_string(doc) == receiver.to_string()
+        assert SA.doc_spans(doc) == oracle.doc_spans()
+
     @pytest.mark.parametrize("seed", [1, 17])
     def test_n_peer_random_interleavings_converge(self, seed):
         # SURVEY §4's missing `random_concurrency` test, on the device

@@ -47,13 +47,24 @@ def random_patches(
     return patches, content
 
 
-def make_storm(n_peers: int, rounds: int, run_len: int, seed: int = 0):
+def make_storm(n_peers: int, rounds: int, run_len: int, seed: int = 0,
+               del_prob: float = 0.0):
     """(txns, oracle) for the concurrent-insert storm (config 4).
 
     Each peer types ``run_len`` chars at position 0 of its own replica
     every round; the exported txns are interleaved round-robin (a valid
     causal order — peers only depend on themselves) and applied to a
     receiving oracle for ground truth.
+
+    With ``del_prob`` > 0 a peer's round is, with that probability, a
+    DELETE instead: the peer first merges every txn emitted in earlier
+    rounds (so it can see — and delete — other peers' chars), then
+    deletes a random span.  Two peers deleting overlapping spans in the
+    same round produce concurrent double deletes
+    (`double_delete.rs:6-9`); the round-robin order stays causally
+    valid because merges only cover strictly earlier rounds.
+    ``del_prob=0`` draws no extra randomness, so existing seeded
+    streams are unchanged.
     """
     from ..models.oracle import ListCRDT
     from ..models.sync import export_txns_since
@@ -67,15 +78,38 @@ def make_storm(n_peers: int, rounds: int, run_len: int, seed: int = 0):
 
     per_round: List[List] = []
     marks = [0] * n_peers
+    merged_upto = [0] * n_peers  # txns (flat index) each peer has merged
+    flat: List = []
     for _ in range(rounds):
         round_txns = []
+        prior = len(flat)  # merges may only cover earlier rounds
         for p, (doc, agent) in enumerate(peers):
-            text = "".join(rng.choice(ALPHABET) for _ in range(run_len))
-            doc.local_insert(agent, 0, text)
+            is_del = bool(del_prob) and rng.random() < del_prob
+            if is_del:
+                me = f"peer-{p:03d}"
+                for t in flat[merged_upto[p]:prior]:
+                    if t.id.agent != me:  # own history is already local
+                        doc.apply_remote_txn(t)
+                merged_upto[p] = prior
+                # Export must cover ONLY the op below, not the merged
+                # history (those orders belong to other agents).
+                marks[p] = doc.get_next_order()
+                n = len(doc)
+                if n == 0:
+                    is_del = False
+                else:
+                    pos = rng.randint(0, n - 1)
+                    span = min(rng.randint(1, run_len), n - pos)
+                    doc.local_delete(agent, pos, span)
+            if not is_del:
+                text = "".join(rng.choice(ALPHABET)
+                               for _ in range(run_len))
+                doc.local_insert(agent, 0, text)
             txns = export_txns_since(doc, marks[p])
             marks[p] = doc.get_next_order()
             round_txns.extend(txns)
         per_round.append(round_txns)
+        flat.extend(round_txns)
 
     txns = [t for rnd in per_round for t in rnd]
     receiver = ListCRDT()
