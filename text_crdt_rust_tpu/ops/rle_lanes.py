@@ -72,12 +72,31 @@ def _vshift(x, amt):
     return jnp.where(amt == 0, x, jnp.where(amt == 1, r1, r2))
 
 
+def _live_prefix(bo, bl):
+    """(lv, cum): live char counts per run row and their inclusive
+    prefix — the most expensive pass of a step (log2(CAP) roll-adds)."""
+    lv = jnp.where(bo > 0, bl, 0)
+    return lv, _vcumsum(lv)
+
+
+def _shared_cum_gate(step_has_del, step_has_ins, s_pad: int) -> bool:
+    """Hoist one live prefix per step iff it pays: sound only when no
+    lane deletes AND inserts in the same step (callers check that
+    separately), and worth it only when steps running BOTH branches
+    (two cumsums -> one) outnumber steps running NEITHER (zero
+    cumsums -> one: remote-only or padding steps)."""
+    both = int((step_has_del & step_has_ins).sum())
+    neither = int((~(step_has_del | step_has_ins)).sum())
+    neither += s_pad - len(step_has_del)  # padded no-op steps
+    return both > neither
+
+
 def _rle_lanes_kernel(
     pos_ref, dlen_ref, ilen_ref, start_ref,     # [CHUNK,B] VMEM op columns
     ord0_ref, len0_ref, rows0_ref,              # warm-start state inputs
     ol_ref, or_ref,                             # [CHUNK,B] outputs
     ordp, lenp, rowsv, err_ref,                 # state outputs (working)
-    *, CAP: int, CHUNK: int,
+    *, CAP: int, CHUNK: int, SHARED_CUM: bool = False,
 ):
     B = ordp.shape[1]
     # Grid = (lane blocks, chunks): lanes are independent documents, so
@@ -99,8 +118,10 @@ def _rle_lanes_kernel(
         rowsv[:] = rows0_ref[:]
         err_ref[:] = jnp.zeros_like(err_ref)
 
-    def do_delete(p, d):
-        """Whole-doc single-pass delete, per-lane (active where d > 0)."""
+    def do_delete(p, d, lv=None, cum=None):
+        """Whole-doc single-pass delete, per-lane (active where d > 0).
+        ``lv``/``cum`` may be the step-hoisted live prefix (see
+        ``op_body``); the delete runs first, so they are always fresh."""
         active = d > 0
         rows = rowsv[:]
 
@@ -111,8 +132,8 @@ def _rle_lanes_kernel(
 
         bo = ordp[:]
         bl = lenp[:]
-        lv = jnp.where(bo > 0, bl, 0)
-        cum = _vcumsum(lv)
+        if cum is None:
+            lv, cum = _live_prefix(bo, bl)
         before = cum - lv
         rem = jnp.where(active, d, 0)
         cs = jnp.clip(p - before, 0, lv)
@@ -167,8 +188,16 @@ def _rle_lanes_kernel(
         lenp[:] = bl
         rowsv[:] = rowsv[:] + jnp.where(active, a1 + a2, 0)
 
-    def do_insert(k, p, il, st):
-        """Per-lane insert splice (active where il > 0)."""
+    def do_insert(k, p, il, st, lv=None, cum=None):
+        """Per-lane insert splice (active where il > 0).
+
+        ``lv``/``cum`` may be the step-hoisted PRE-DELETE live prefix:
+        valid for this branch's active lanes because the shared-cum
+        mode statically guarantees no lane deletes AND inserts in the
+        same step, so an insert-active lane's column was untouched by
+        the delete branch.  ``bo``/``bl`` are always read FRESH —
+        the transform writes whole planes and must preserve the delete
+        branch's results on the OTHER lanes."""
         active = il > 0
         rows = rowsv[:]
 
@@ -179,8 +208,8 @@ def _rle_lanes_kernel(
 
         bo = ordp[:]
         bl = lenp[:]
-        lv = jnp.where(bo > 0, bl, 0)
-        cum = _vcumsum(lv)
+        if cum is None:
+            lv, cum = _live_prefix(bo, bl)
         local = jnp.where(active, p, 0)
         i_r = jnp.sum(((cum < local) & (idx < rows)).astype(jnp.int32),
                       axis=0, keepdims=True)
@@ -232,13 +261,23 @@ def _rle_lanes_kernel(
         il = ilen_ref[pl.ds(k, 1), :]
         st = start_ref[pl.ds(k, 1), :]
 
+        if SHARED_CUM:
+            # One live prefix serves BOTH branches: the builder proved
+            # statically that no lane deletes AND inserts in the same
+            # step (so the insert branch's active lanes see exactly
+            # this pre-delete prefix) AND that both-branch steps
+            # outnumber no-op steps (so the unconditional hoist pays).
+            lv, cum = _live_prefix(ordp[:], lenp[:])
+        else:
+            lv = cum = None
+
         @pl.when(jnp.any(d > 0))
         def _():
-            do_delete(p, d)
+            do_delete(p, d, lv, cum)
 
         @pl.when(jnp.any(il > 0))
         def _():
-            do_insert(k, p, il, st)
+            do_insert(k, p, il, st, lv, cum)
 
         return 0
 
@@ -290,7 +329,8 @@ def _lane_tile(B: int) -> int:
 
 @functools.lru_cache(maxsize=32)
 def _build_call(s_pad: int, B: int, capacity: int, chunk: int,
-                interpret: bool, lane_tile: int | None = None):
+                interpret: bool, lane_tile: int | None = None,
+                shared_cum: bool = False):
     """Shape-keyed cache: streaming chunks share one compiled kernel
     (a per-chunk pallas_call would re-trace and re-compile ~5-30s each —
     the whole point of warm starts is that chunk N+1 is cheap)."""
@@ -302,7 +342,8 @@ def _build_call(s_pad: int, B: int, capacity: int, chunk: int,
         (shape[0], T), lambda lb, i: (0, lb), memory_space=pltpu.VMEM)
 
     call = pl.pallas_call(
-        partial(_rle_lanes_kernel, CAP=capacity, CHUNK=chunk),
+        partial(_rle_lanes_kernel, CAP=capacity, CHUNK=chunk,
+                SHARED_CUM=shared_cum),
         grid=(B // T, s_pad // chunk),
         in_specs=[col(), col(), col(), col(),
                   whole((capacity, B)), whole((capacity, B)),
@@ -369,7 +410,17 @@ def make_replayer_lanes(
     else:
         init = _grow_planes(init, capacity, B)
 
-    jitted = _build_call(s_pad, B, capacity, chunk, interpret, lane_tile)
+    # One live prefix can serve both branches of a step iff NO lane
+    # deletes AND inserts in the same step (a compiled replace patch),
+    # and the hoist only pays on streams where mixed-kind steps
+    # dominate (see _shared_cum_gate).
+    dn = np.asarray(ops.del_len)
+    iln = np.asarray(ops.ins_len)
+    shared_cum = (not bool(np.any((dn > 0) & (iln > 0)))
+                  and _shared_cum_gate((dn > 0).any(axis=1),
+                                       (iln > 0).any(axis=1), s_pad))
+    jitted = _build_call(s_pad, B, capacity, chunk, interpret, lane_tile,
+                         shared_cum)
 
     def run(state=None) -> LanesResult:
         ini = init if state is None else _grow_planes(state, capacity, B)

@@ -68,7 +68,15 @@ from .batch import (
     _prefill_scatter,
 )
 from .blocked import _require
-from .rle_lanes import LanesResult, _lane_tile, _vcumsum, _vrow, _vshift
+from .rle_lanes import (
+    LanesResult,
+    _lane_tile,
+    _live_prefix,
+    _shared_cum_gate,
+    _vcumsum,
+    _vrow,
+    _vshift,
+)
 
 TAB_UNKNOWN = -2  # by-order table sentinel: entry not yet known
 
@@ -84,7 +92,7 @@ def _mixed_lanes_kernel(
     ordp, lenp, rowsv,                          # state outputs (working)
     oll, orl,                                   # table outputs (working)
     err_ref,
-    *, CAP: int, OCAP: int, CHUNK: int,
+    *, CAP: int, OCAP: int, CHUNK: int, SHARED_CUM: bool = False,
 ):
     B = ordp.shape[1]
     i = pl.program_id(1)
@@ -204,13 +212,13 @@ def _mixed_lanes_kernel(
         nl = jnp.where(w2, ln - ce_i, nl)
         return no, nl, amt
 
-    def do_local_delete(act, p, d):
+    def do_local_delete(act, p, d, lv=None, cum=None):
         """Whole-doc single-pass tombstone (rle_lanes.do_delete)."""
         flag_capacity(act)
         bo = ordp[:]
         bl = lenp[:]
-        lv = jnp.where(bo > 0, bl, 0)
-        cum = _vcumsum(lv)
+        if cum is None:
+            lv, cum = _live_prefix(bo, bl)
         before = cum - lv
         rem = jnp.where(act, d, 0)
         cs = jnp.clip(p - before, 0, lv)
@@ -236,15 +244,19 @@ def _mixed_lanes_kernel(
         lenp[:] = bl
         rowsv[:] = rowsv[:] + jnp.where(act, a1 + a2, 0)
 
-    def do_local_insert(act, k, p, il, st):
+    def do_local_insert(act, k, p, il, st, lv=None, cum=None):
         """rle_lanes.do_insert + by-order table upkeep (the origins a
-        local insert discovers at apply time, `doc.rs:447-453`)."""
+        local insert discovers at apply time, `doc.rs:447-453`).
+        ``lv``/``cum`` may be the step-hoisted PRE-DELETE live prefix
+        (valid: shared-cum mode excludes same-lane delete+insert
+        steps); ``bo``/``bl`` stay FRESH so the whole-plane writes
+        preserve the delete branch's results on other lanes."""
         flag_capacity(act)
         rows = rowsv[:]
         bo = ordp[:]
         bl = lenp[:]
-        lv = jnp.where(bo > 0, bl, 0)
-        cum = _vcumsum(lv)
+        if cum is None:
+            lv, cum = _live_prefix(bo, bl)
         local = jnp.where(act, p, 0)
         i_r = jnp.sum(((cum < local) & (idx < rows)).astype(jnp.int32),
                       axis=0, keepdims=True)
@@ -466,13 +478,21 @@ def _mixed_lanes_kernel(
         act_ri = (kind == KIND_REMOTE_INS) & (il > 0)
         act_rd = (kind == KIND_REMOTE_DEL) & (d > 0)
 
+        if SHARED_CUM:
+            # One live prefix serves both LOCAL branches (no lane
+            # deletes AND inserts in one step, and both-branch steps
+            # outnumber no-local steps — both checked statically).
+            lv, cum = _live_prefix(ordp[:], lenp[:])
+        else:
+            lv = cum = None
+
         @pl.when(jnp.any(act_ld))
         def _():
-            do_local_delete(act_ld, p, d)
+            do_local_delete(act_ld, p, d, lv, cum)
 
         @pl.when(jnp.any(act_li))
         def _():
-            do_local_insert(act_li, k, p, il, st)
+            do_local_insert(act_li, k, p, il, st, lv, cum)
 
         @pl.when(jnp.any(act_ri))
         def _():
@@ -538,7 +558,8 @@ def lane_tables(stacked: OpTensors, ocap: int):
 
 @functools.lru_cache(maxsize=32)
 def _build_call(s_pad: int, B: int, capacity: int, ocap: int, chunk: int,
-                interpret: bool, lane_tile: int | None = None):
+                interpret: bool, lane_tile: int | None = None,
+                shared_cum: bool = False):
     """Shape-keyed cache (streaming chunks share one compiled kernel)."""
     T = lane_tile or _lane_tile(B)
     _require(B % T == 0, f"lane_tile {T} must divide batch {B}")
@@ -549,7 +570,7 @@ def _build_call(s_pad: int, B: int, capacity: int, ocap: int, chunk: int,
 
     call = pl.pallas_call(
         partial(_mixed_lanes_kernel, CAP=capacity, OCAP=ocap,
-                CHUNK=chunk),
+                CHUNK=chunk, SHARED_CUM=shared_cum),
         grid=(B // T, s_pad // chunk),
         in_specs=[col() for _ in range(9)] + [
             whole(capacity), whole(capacity), whole(1),
@@ -647,8 +668,20 @@ def make_replayer_lanes_mixed(
     else:
         init = _grow_state(init, capacity, ocap, B)
 
+    # Shared live prefix for the local branches: sound only when no
+    # lane deletes AND inserts in the same step (a compiled replace
+    # patch), and worth it only when steps firing BOTH local branches
+    # outnumber steps firing neither — a remote-heavy stream with one
+    # stray local op must not pay the hoist on every step (review r5).
+    kn, dn, iln = (np.asarray(ops.kind), np.asarray(ops.del_len),
+                   np.asarray(ops.ins_len))
+    ld = (kn == KIND_LOCAL) & (dn > 0)
+    li = (kn == KIND_LOCAL) & (iln > 0)
+    shared_cum = (not bool(np.any(ld & li))
+                  and _shared_cum_gate(ld.any(axis=1), li.any(axis=1),
+                                       s_pad))
     jitted = _build_call(s_pad, B, capacity, ocap, chunk,
-                         interpret, lane_tile)
+                         interpret, lane_tile, shared_cum)
     deltas = (jnp.asarray(olld), jnp.asarray(orld), jnp.asarray(rkl))
 
     def run(state=None) -> LanesMixedResult:
