@@ -721,7 +721,8 @@ def cfg_4(args):
     assert dbase_str == dwant
     dtable = B.AgentTable(sorted({t.id.agent for t in dtxns}))
     dops, _ = B.compile_remote_txns(dtxns, dtable,
-                                    lmax=min(16, run_len * 2), dmax=16)
+                                    lmax=min(16, run_len * 2),
+                                    dmax=None)  # one-pass interval delete
     d_chars = sum(sum(getattr(op, "len",
                               len(getattr(op, "ins_content", "")))
                       for op in t.ops) for t in dtxns)

@@ -48,5 +48,9 @@ if os.path.exists("BENCH_ALL.json"):
     os.replace("BENCH_ALL.json.tmp", "BENCH_ALL.json")
 EOF
 python bench.py --config all --resume >> perf/bench_all_r5.log 2>&1
-# One TPU process at a time: the geometry sweep runs after the suite.
+# One TPU process at a time: geometry compile pins (fail loudly on a
+# shape regression, VERDICT r4 next #6), then the measured-capacity
+# sweep. `|| true` on the pin: a pin failure must not eat the sweep —
+# its log is the loud signal.
+python perf/compile_pin.py >> perf/compile_pin_r5.log 2>&1 || true
 exec python perf/sweep_r4.py --quick >> perf/sweep_r5_run.log 2>&1

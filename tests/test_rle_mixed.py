@@ -33,14 +33,14 @@ from test_device_flat import (
 ROOT = RemoteId("ROOT", 0xFFFFFFFF)
 
 
-def replay_txns(txns, capacity, block_k=8, lmax=4, chunk=128):
+def replay_txns(txns, capacity, block_k=8, lmax=4, chunk=128, dmax=16):
     table = B.AgentTable()
     for t in txns:
         table.add(t.id.agent)
         for op in t.ops:
             if hasattr(op, "id"):
                 table.add(op.id.agent)
-    ops, _ = B.compile_remote_txns(txns, table, lmax=lmax, dmax=16)
+    ops, _ = B.compile_remote_txns(txns, table, lmax=lmax, dmax=dmax)
     res = RM.replay_mixed_rle(ops, capacity=capacity, batch=8,
                               block_k=block_k, chunk=chunk, interpret=True)
     return R.rle_to_flat(ops, res)
@@ -153,16 +153,46 @@ class TestMixedRleRemote:
         assert SA.to_string(doc) == oracle.to_string()
         assert SA.doc_spans(doc) == oracle.doc_spans()
 
-    def test_long_remote_delete_chunked(self):
-        # A delete run longer than dmax=16 must chunk and still converge.
+    @pytest.mark.parametrize("dmax", [16, None])
+    def test_long_remote_delete(self, dmax):
+        # A 40-target delete both dmax-chunked and UNCHUNKED (the
+        # one-pass interval delete takes any length in one step) must
+        # converge; the unchunked form spans multiple 16-row blocks,
+        # exercising the plane-wide flip + slot-count gather.
         base = RemoteTxn(id=RemoteId("amy", 0), parents=[],
                          ops=[RemoteIns(ROOT, ROOT, "x" * 50)])
         kill = RemoteTxn(id=RemoteId("bob", 0), parents=[RemoteId("amy", 49)],
                          ops=[RemoteDel(RemoteId("amy", 5), 40)])
         txns = [base, kill]
         oracle = oracle_txns(txns)
-        doc = replay_txns(txns, capacity=128, block_k=16, lmax=16)
+        doc = replay_txns(txns, capacity=128, block_k=16, lmax=16,
+                          dmax=dmax)
         assert SA.to_string(doc) == oracle.to_string() == "x" * 10
+        assert SA.doc_spans(doc) == oracle.doc_spans()
+
+    def test_unchunked_delete_spans_many_fragmented_blocks(self):
+        # Interleave two peers' typing so amy's chars are fragmented
+        # across blocks, then delete amy's whole range unchunked: full
+        # covers flip plane-wide in ONE step while bob's interleaved
+        # chars survive.
+        txns = []
+        for k in range(12):
+            txns.append(RemoteTxn(
+                id=RemoteId("amy", 2 * k), parents=[],
+                ops=[RemoteIns(ROOT if k == 0 else RemoteId("amy", 2 * k - 1),
+                               ROOT, "aa")]))
+        for k in range(12):
+            txns.append(RemoteTxn(
+                id=RemoteId("bob", k), parents=[],
+                ops=[RemoteIns(ROOT if k == 0 else RemoteId("bob", k - 1),
+                               RemoteId("amy", 2 * k), "B")]))
+        txns.append(RemoteTxn(
+            id=RemoteId("cat", 0), parents=[RemoteId("amy", 23)],
+            ops=[RemoteDel(RemoteId("amy", 2), 20)]))
+        oracle = oracle_txns(txns)
+        doc = replay_txns(txns, capacity=128, block_k=8, lmax=4,
+                          dmax=None)
+        assert SA.to_string(doc) == oracle.to_string()
         assert SA.doc_spans(doc) == oracle.doc_spans()
 
     def test_delete_inside_merged_run_then_insert(self):

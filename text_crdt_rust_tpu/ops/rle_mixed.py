@@ -31,13 +31,14 @@ What the remote paths add on top of ``ops.rle``'s block grid:
   only break mid-run at the op's ``origin_right`` — each loop iteration
   therefore consumes a WHOLE run (or jumps straight to origin_right
   inside it), shrinking the scan by the run factor.
-- **run-level remote delete**: a bitmask walk over the <= ``dmax``-long
-  target order range; each iteration resolves the lowest unhandled
-  order to its run, splits that run at the covered sub-range (<= 3
-  parts, tombstone mid), and clears the whole covered span's bits at
-  once.  Already-dead runs retire their bits without flipping
-  (idempotent concurrent deletes, `double_delete.rs:6-9`; excess
-  counting stays host-side per SURVEY).
+- **one-pass remote delete**: runs are disjoint ORDER intervals, so a
+  target range ``[t, t+dlen)`` fully covers every run it touches except
+  at most the two holding its endpoints — one plane-wide flip of the
+  full covers (block live counts updated off one plane cumsum) plus
+  <= 2 by-order endpoint fix-ups (3-way splits).  Already-dead covered
+  runs count toward the idempotency total without flipping (idempotent
+  concurrent deletes, `double_delete.rs:6-9`; excess counting stays
+  host-side per SURVEY).  Any ``dlen`` in one step.
 
 Same lane batching as ``ops.rle`` (all docs replay one shared stream),
 same ``RleResult`` / ``rle_to_flat`` result surface.
@@ -148,7 +149,7 @@ def _mixed_rle_kernel(
     blkord, rws, liv, raw, cumliv, cumraw,      # VMEM scratch (cum* =
     ordblk, oll, orl,                           #   incremental inclusive
     meta,                                       #   prefixes; SMEM scratch
-    *, K: int, NB: int, NBL: int, CHUNK: int, OT: int, DMAX: int,
+    *, K: int, NB: int, NBL: int, CHUNK: int, OT: int,
 ):
     B = ordp.shape[1]
     CAP = K * NB
@@ -547,84 +548,115 @@ def _mixed_rle_kernel(
 
     # ---- remote delete (`doc.rs:295-340`) -------------------------------
 
-    def do_remote_delete(t, dlen):
-        """Tombstone orders [t, t+dlen).  A bit in ``mask`` = a target
-        order not yet accounted for; each iteration resolves the lowest
-        one to its RUN, splits the covered sub-range out as a tombstone
-        (<= 3 parts), and clears every covered bit at once."""
-        full = jnp.left_shift(jnp.int32(1), dlen) - 1
+    def retire_endpoint(t, dlen, o):
+        """Split the covered sub-range out of the run containing order
+        ``o`` (one former-walk iteration).  No-op unless that run is
+        LIVE and PARTIALLY covered — full covers were flipped by the
+        caller's plane pass, dead runs are idempotent retires."""
+        b, row = locate_order(o)
+        l = logical_of_physical(b)
 
-        def body(carry):
-            mask, iters = carry
-            low = mask & (-mask)
-            # floor(log2) via scalar shifts — Mosaic has no scalar
-            # population-count.
-            v = low
-            k0 = jnp.int32(0)
-            for sh in (16, 8, 4, 2, 1):
-                ge = (v >> sh) != 0
-                k0 = k0 + jnp.where(ge, sh, 0)
-                v = jnp.where(ge, v >> sh, v)
-            o = t + k0
-            b, row = locate_order(o)
-            l = logical_of_physical(b)
-
-            @pl.when(slot_scalar(rws, l) + 2 > K)
-            def _():
-                split(l)
-
-            b, row = locate_order(o)
-            l = logical_of_physical(b)
+        def run_facts():
             bo = ordp[pl.ds(b * K, K), :]
             bl = lenp[pl.ds(b * K, K), :]
             o_r = _row_scalar(bo, row, idx_k)
             l_r = _row_scalar(bl, row, idx_k)
             so = jnp.abs(o_r) - 1
-            a = o - so
+            a = jnp.maximum(t - so, 0)
+            e = jnp.minimum(l_r, t + dlen - so)
+            return bo, bl, o_r, l_r, so, a, e
+
+        _, _, o_r, l_r, so, a, e = run_facts()
+        partial = (o_r > 0) & ((a > 0) | (e < l_r)) & (e > a)
+
+        @pl.when(partial & (slot_scalar(rws, l) + 2 > K))
+        def _():
+            split(l)
+
+        @pl.when(partial)
+        def _fix():
+            b2, row2 = locate_order(o)  # split may have moved the run
+            l2 = logical_of_physical(b2)
+            bo = ordp[pl.ds(b2 * K, K), :]
+            bl = lenp[pl.ds(b2 * K, K), :]
+            o_r = _row_scalar(bo, row2, idx_k)
+            l_r = _row_scalar(bl, row2, idx_k)
+            so = jnp.abs(o_r) - 1
+            a = jnp.maximum(t - so, 0)
             e = jnp.minimum(l_r, t + dlen - so)
             cov = e - a
-            live = o_r > 0
+            has_head = a > 0
+            has_tail = e < l_r
+            amt = has_head.astype(jnp.int32) + has_tail.astype(jnp.int32)
+            sh_o = _shift_rows(bo, amt, 2)
+            sh_l = _shift_rows(bl, amt, 2)
+            no = jnp.where(idx_k <= row2, bo, sh_o)
+            nl = jnp.where(idx_k <= row2, bl, sh_l)
+            # Part layout: [head?] [tombstone mid] [tail?].
+            p0o = jnp.where(has_head, o_r, -(so + a + 1))
+            p0l = jnp.where(has_head, a, cov)
+            p1o = jnp.where(has_head, -(so + a + 1), so + e + 1)
+            p1l = jnp.where(has_head, cov, l_r - e)
+            w0 = idx_k == row2
+            no = jnp.where(w0, p0o, no)
+            nl = jnp.where(w0, p0l, nl)
+            w1 = (idx_k == row2 + 1) & (amt >= 1)
+            no = jnp.where(w1, p1o, no)
+            nl = jnp.where(w1, p1l, nl)
+            w2 = (idx_k == row2 + 2) & (amt == 2)
+            no = jnp.where(w2, so + e + 1, no)
+            nl = jnp.where(w2, l_r - e, nl)
+            ordp[pl.ds(b2 * K, K), :] = no
+            lenp[pl.ds(b2 * K, K), :] = nl
+            rws[pl.ds(l2, 1), :] = rws[pl.ds(l2, 1), :] + amt
+            liv[pl.ds(l2, 1), :] = liv[pl.ds(l2, 1), :] - cov
+            cumliv[:] = jnp.where(idx_l >= l2, cumliv[:] - cov,
+                                  cumliv[:])
 
-            @pl.when(live)
-            def _flip():
-                has_head = a > 0
-                has_tail = e < l_r
-                amt = has_head.astype(jnp.int32) + has_tail.astype(jnp.int32)
-                sh_o = _shift_rows(bo, amt, 2)
-                sh_l = _shift_rows(bl, amt, 2)
-                no = jnp.where(idx_k <= row, bo, sh_o)
-                nl = jnp.where(idx_k <= row, bl, sh_l)
-                # Part layout: [head?] [tombstone mid] [tail?].
-                p0o = jnp.where(has_head, o_r, -(so + a + 1))
-                p0l = jnp.where(has_head, a, cov)
-                p1o = jnp.where(has_head, -(so + a + 1), so + e + 1)
-                p1l = jnp.where(has_head, cov, l_r - e)
-                w0 = idx_k == row
-                no = jnp.where(w0, p0o, no)
-                nl = jnp.where(w0, p0l, nl)
-                w1 = (idx_k == row + 1) & (amt >= 1)
-                no = jnp.where(w1, p1o, no)
-                nl = jnp.where(w1, p1l, nl)
-                w2 = (idx_k == row + 2) & (amt == 2)
-                no = jnp.where(w2, so + e + 1, no)
-                nl = jnp.where(w2, l_r - e, nl)
-                ordp[pl.ds(b * K, K), :] = no
-                lenp[pl.ds(b * K, K), :] = nl
-                rws[pl.ds(l, 1), :] = rws[pl.ds(l, 1), :] + amt
-                liv[pl.ds(l, 1), :] = liv[pl.ds(l, 1), :] - cov
-                cumliv[:] = jnp.where(idx_l >= l, cumliv[:] - cov,
-                                      cumliv[:])
+    def do_remote_delete(t, dlen):
+        """One-pass ORDER-interval tombstone (`doc.rs:295-340` without
+        the fragmentation walk; see ops.rle_lanes_mixed): runs are
+        disjoint order intervals, so [t, t+dlen) fully covers every run
+        it touches except at most the two holding its endpoints — flip
+        the full covers plane-wide, fix up the <= 2 partial runs by
+        order lookup, and count covered DEAD runs toward the
+        idempotency total (`double_delete.rs:6-9`).  Any ``dlen`` in
+        one step — no dmax pre-chunking."""
+        bo = ordp[:]
+        bl = lenp[:]
+        so = jnp.abs(bo) - 1
+        occ = bo != 0
+        cs = jnp.clip(t - so, 0, bl)
+        ce = jnp.clip(t + dlen - so, 0, bl)
+        cov = jnp.where(occ, ce - cs, 0)
+        tot = jnp.max(jnp.sum(cov, axis=0))
 
-            bits = jnp.left_shift(
-                jnp.left_shift(jnp.int32(1), cov) - 1, k0)
-            return mask & ~bits, iters + 1
-
-        mask, _ = lax.while_loop(
-            lambda c: (c[0] != 0) & (c[1] <= DMAX), body, (full, 0))
-
-        @pl.when(mask != 0)
+        @pl.when(tot < dlen)
         def _bad():
             err_ref[1:2, :] = jnp.ones((1, B), jnp.int32)
+
+        live = bo > 0
+        full = live & (cov > 0) & (cov == bl)
+        # Flip plane-wide; per-slot live counts drop by each block's
+        # flipped chars (raw counts are unchanged by tombstoning).
+        # Block sums come off ONE plane cumsum via static row reads
+        # (Mosaic has no [NB, K, B] reshape), then gather to logical
+        # slots through ``blkord`` (NB masked adds on the tiny table).
+        ordp[:] = jnp.where(full, -bo, bo)
+        cumfull = _cumsum_rows(jnp.where(full, bl, 0))
+        g = jnp.zeros((NBL, B), jnp.int32)
+        for b_ in range(NB):
+            hi = cumfull[(b_ + 1) * K - 1][jnp.newaxis, :]
+            lo = (cumfull[b_ * K - 1][jnp.newaxis, :] if b_ > 0
+                  else jnp.zeros((1, B), jnp.int32))
+            g = g + jnp.where(blkord[:] == b_, hi - lo, 0)
+        liv[:] = liv[:] - g
+        cumliv[:] = cumliv[:] - _cumsum_rows(g)
+
+        # The <= 2 live partial runs each contain an endpoint; relocate
+        # by order (splits move rows) and 3-way split them.
+        retire_endpoint(t, dlen, t + dlen - 1)
+        retire_endpoint(t, dlen, t)
 
     # ---- dispatch -------------------------------------------------------
 
@@ -674,10 +706,10 @@ def make_replayer_rle_mixed(
     """Stage a mixed local/remote op stream on the RUN representation and
     build a jitted replayer.
 
-    ``capacity`` counts RUN rows (`ops.rle` contract).  Remote delete
-    runs must be pre-chunked to <= 16 targets per step
-    (``compile_remote_txns(..., dmax=16)``); insert chunks must be
-    <= 128 chars (the order-table write window).
+    ``capacity`` counts RUN rows (`ops.rle` contract).  Remote deletes
+    of any length apply in one step (the one-pass interval delete needs
+    no dmax pre-chunking); insert chunks must be <= 128 chars (the
+    order-table write window).
     """
     kinds = np.asarray(ops.kind)
     _require(kinds.ndim == 1, "rle-mixed engine takes one shared stream")
@@ -694,11 +726,6 @@ def make_replayer_rle_mixed(
         f"insert chunks must be <= {LANES} chars for the order-table "
         f"window (compile with lmax<={LANES})"))
     NBLp = max(8, NB)
-    dlens = np.asarray(ops.del_len)[kinds == KIND_REMOTE_DEL]
-    dmax = 16
-    _require(dlens.size == 0 or int(dlens.max()) <= dmax, (
-        f"remote delete runs must be <= {dmax} targets per step "
-        f"(compile with dmax={dmax})"))
 
     # By-order tables: everything the compiler knows (remote origins,
     # within-run chains, ranks), packed 128 orders/row, i32 (ROOT -> -1
@@ -738,7 +765,7 @@ def make_replayer_rle_mixed(
 
     call = pl.pallas_call(
         partial(_mixed_rle_kernel, K=block_k, NB=NB, NBL=NBLp, CHUNK=chunk,
-                OT=OT, DMAX=dmax),
+                OT=OT),
         grid=(s_pad // chunk,),
         in_specs=[smem() for _ in range(9)] + [
             whole((OT, LANES)), whole((OT, LANES)), whole((OT, LANES))],
