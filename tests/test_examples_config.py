@@ -36,6 +36,18 @@ class TestSoakCli:
         assert "content OK" in out
 
 
+class TestSyncStreamCli:
+    def test_small_sync_stream_runs(self, capsys):
+        from text_crdt_rust_tpu.examples.sync_stream import main
+
+        rc = main(["--docs", "3", "--chunks", "2",
+                   "--ops-per-chunk", "8", "--seed", "3"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "docs == oracle" in out
+        assert "every chunk oracle-checked" in out
+
+
 class TestStatsCli:
     @pytest.mark.parametrize("engine", ["native", "oracle"])
     def test_stats_runs(self, engine, capsys):
