@@ -47,7 +47,12 @@ if os.path.exists("BENCH_ALL.json"):
         json.dump(rows, f, indent=1)
     os.replace("BENCH_ALL.json.tmp", "BENCH_ALL.json")
 EOF
-python bench.py --config all --resume >> perf/bench_all_r5.log 2>&1
+# A mid-suite crash (e.g. a kevin OOM) must not eat the pins/sweep:
+# finished rows are already persisted per-config by RowSink, and the
+# log carries the failure loudly.
+python bench.py --config all --resume >> perf/bench_all_r5.log 2>&1 || \
+  echo "bench exited nonzero; rows up to the failure are persisted" \
+    >> perf/bench_all_r5.log
 # One TPU process at a time: geometry compile pins (fail loudly on a
 # shape regression, VERDICT r4 next #6), then the measured-capacity
 # sweep. `|| true` on the pin: a pin failure must not eat the sweep —
