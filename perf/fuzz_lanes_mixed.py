@@ -1,0 +1,123 @@
+"""Long-running differential fuzz: rle_lanes_mixed vs the oracle.
+
+Loops over seeds, each round building a batch of divergent lanes that
+mix the hard remote shapes — multi-peer merges, concurrent storms with
+deletes (make_storm del_prob), and causal-buffer-reordered arrivals —
+and asserting per-lane signed-char equality with the oracle.  Failures
+print the seed and stop; run under nohup during idle time:
+
+    python perf/fuzz_lanes_mixed.py [--rounds N] [--start-seed S]
+"""
+import argparse
+import random
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from text_crdt_rust_tpu.models.oracle import ListCRDT
+from text_crdt_rust_tpu.models.sync import export_txns_since
+from text_crdt_rust_tpu.ops import batch as B
+from text_crdt_rust_tpu.ops import rle_lanes as RL
+from text_crdt_rust_tpu.ops import rle_lanes_mixed as RLM
+from text_crdt_rust_tpu.parallel.causal import CausalBuffer
+from text_crdt_rust_tpu.utils.randedit import make_storm, random_patches
+
+
+def peer(rng, n, agent):
+    doc = ListCRDT()
+    a = doc.get_or_create_agent_id(agent)
+    patches, _ = random_patches(rng, n)
+    for p in patches:
+        if p.del_len:
+            doc.local_delete(a, p.pos, p.del_len)
+        if p.ins_content:
+            doc.local_insert(a, p.pos, p.ins_content)
+    return doc
+
+
+def lane_stream(rng, seed):
+    """One lane's txn stream: a random hard shape."""
+    shape = rng.randrange(3)
+    if shape == 0:  # multi-peer merge, shuffled through the buffer
+        txns = []
+        for name in ("ann", "bob", "cyd")[: 2 + rng.randrange(2)]:
+            txns.extend(export_txns_since(
+                peer(rng, 10 + rng.randrange(25), name), 0))
+        rng.shuffle(txns)
+        buf = CausalBuffer()
+        released = buf.add_all(txns)
+        assert buf.pending == 0
+        return released
+    if shape == 1:  # concurrent storm with cross-peer deletes
+        txns, _ = make_storm(2 + rng.randrange(3), 3 + rng.randrange(5),
+                             1 + rng.randrange(3), seed=seed,
+                             del_prob=0.25 + rng.random() * 0.3)
+        return txns
+    # interleaved independent peers (different causal order per lane)
+    streams = [export_txns_since(peer(rng, 8 + rng.randrange(15), n), 0)
+               for n in ("kim", "lou")]
+    out = []
+    queues = [list(s) for s in streams]
+    while any(queues):
+        live = [q for q in queues if q]
+        out.append(rng.choice(live).pop(0))
+    return out
+
+
+def one_round(seed: int) -> int:
+    rng = random.Random(seed)
+    lanes = 3 + rng.randrange(4)
+    lane_txns = [lane_stream(rng, seed * 100 + k) for k in range(lanes)]
+    opses = []
+    for txns in lane_txns:
+        table = B.AgentTable()
+        for t in txns:
+            table.add(t.id.agent)
+            for op in t.ops:
+                if hasattr(op, "id"):
+                    table.add(op.id.agent)
+        ops, _ = B.compile_remote_txns(txns, table, lmax=6, dmax=None)
+        opses.append(ops)
+    stacked = B.stack_ops(opses)
+    res = RLM.replay_lanes_mixed(stacked, capacity=1024, chunk=32,
+                                 interpret=True)
+    res.check()
+    n_ops = 0
+    for d, txns in enumerate(lane_txns):
+        oracle = ListCRDT()
+        for t in txns:
+            oracle.apply_remote_txn(t)
+        want = [(-1 if oracle.deleted[i] else 1)
+                * (int(oracle.order[i]) + 1) for i in range(oracle.n)]
+        got = RL.expand_lane(res, d).tolist()
+        assert got == want, f"seed {seed} lane {d} DIVERGED"
+        n_ops += oracle.n
+    return n_ops
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=200)
+    ap.add_argument("--start-seed", type=int, default=10_000)
+    args = ap.parse_args()
+    t0 = time.time()
+    total = 0
+    for k in range(args.rounds):
+        seed = args.start_seed + k
+        total += one_round(seed)
+        if (k + 1) % 10 == 0:
+            print(f"{k + 1}/{args.rounds} rounds, {total} chars checked, "
+                  f"{time.time() - t0:.0f}s", flush=True)
+    print(f"fuzz OK: {args.rounds} rounds, {total} chars, "
+          f"{time.time() - t0:.0f}s", flush=True)
+
+
+if __name__ == "__main__":
+    main()
