@@ -1216,7 +1216,12 @@ def main() -> None:
                f"batch={args.batch},groups={args.groups},"
                f"kevin_n={args.kevin_n},patches={args.patches}")
     sink = RowSink(args.out, resume=args.resume, variant=variant)
-    for key in ("northstar", "1", "2", "3", "4", "5", "5r", "kevin"):
+    # Priority order, not numeric order: if the tunnel drops mid-suite
+    # (rounds 3-5 all lost device windows), the verdict-critical rows
+    # must already be on disk — northstar first, then the
+    # three-rounds-missing kevin, the unverified-lever configs, and the
+    # CPU-only config 1 last (it needs no device at all).
+    for key in ("northstar", "kevin", "4", "5r", "5", "2", "3", "1"):
         if key in sink.done_keys:
             log(f"=== config {key} === (resumed from {args.out})")
             continue
