@@ -1,0 +1,116 @@
+"""Long-running differential fuzz: the sharded SpDoc (full op surface)
+vs the oracle on the 8-device virtual CPU mesh.
+
+Each round: a random mix of local patches and two-peer remote history
+applied through ``parallel.sp_apply.SpDoc`` (chunked, auto_reshard) —
+signed per-char equality with the oracle after every chunk.  One SpDoc
+and one compiled replay are reused across rounds (state is re-zeroed
+host-side), so rounds after the first are cheap.
+
+    python perf/fuzz_sp_remote.py [--rounds N] [--start-seed S]
+"""
+import argparse
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from text_crdt_rust_tpu.models.oracle import ListCRDT
+from text_crdt_rust_tpu.models.sync import export_txns_since
+from text_crdt_rust_tpu.ops import batch as B
+from text_crdt_rust_tpu.parallel import make_mesh
+from text_crdt_rust_tpu.parallel.sp_apply import TAB_UNKNOWN, SpDoc
+from text_crdt_rust_tpu.utils.randedit import random_patches
+
+
+def reset(doc: SpDoc) -> None:
+    sharding = NamedSharding(doc.mesh, P("sp"))
+    z = lambda n: jax.device_put(jnp.zeros(n, jnp.int32), sharding)
+    doc.ordp = z(doc.nsp * doc.R)
+    doc.lenp = z(doc.nsp * doc.R)
+    doc.rows = jax.device_put(jnp.zeros(doc.nsp, jnp.int32), sharding)
+    doc.oll = jax.device_put(
+        jnp.full(doc.nsp * doc.OTS, TAB_UNKNOWN, jnp.int32), sharding)
+    doc.orl = jax.device_put(
+        jnp.full(doc.nsp * doc.OTS, TAB_UNKNOWN, jnp.int32), sharding)
+    doc.rkl = z(doc.nsp * doc.OTS)
+    doc.ol_log.clear()
+    doc.or_log.clear()
+
+
+def peer(rng, n, agent):
+    d = ListCRDT()
+    a = d.get_or_create_agent_id(agent)
+    patches, _ = random_patches(rng, n)
+    for p in patches:
+        if p.del_len:
+            d.local_delete(a, p.pos, p.del_len)
+        if p.ins_content:
+            d.local_insert(a, p.pos, p.ins_content)
+    return d
+
+
+def one_round(doc: SpDoc, seed: int) -> int:
+    rng = random.Random(seed)
+    reset(doc)
+    oracle = ListCRDT()
+    txns = (export_txns_since(peer(rng, 10 + rng.randrange(20), "pa"), 0)
+            + export_txns_since(peer(rng, 10 + rng.randrange(20), "pb"),
+                                0))
+    table = B.AgentTable()
+    for t in txns:
+        table.add(t.id.agent)
+        for op in t.ops:
+            if hasattr(op, "id"):
+                table.add(op.id.agent)
+    assigner = None
+    step = max(3, len(txns) // (1 + rng.randrange(4)))
+    for at in range(0, len(txns), step):
+        chunk = txns[at:at + step]
+        for t in chunk:
+            oracle.apply_remote_txn(t)
+        ops, assigner = B.compile_remote_txns(
+            chunk, table, assigner=assigner, lmax=6, dmax=None)
+        doc.apply_stream(ops)
+        want = [(-1 if oracle.deleted[i] else 1)
+                * (int(oracle.order[i]) + 1) for i in range(oracle.n)]
+        got = doc.expand().tolist()
+        assert got == want, f"seed {seed} chunk@{at} DIVERGED"
+    return oracle.n
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=60)
+    ap.add_argument("--start-seed", type=int, default=40_000)
+    args = ap.parse_args()
+    mesh = make_mesh(sp=8)
+    doc = SpDoc(mesh, shard_rows=96, order_rows=64, auto_reshard=True)
+    t0 = time.time()
+    total = 0
+    for k in range(args.rounds):
+        total += one_round(doc, args.start_seed + k)
+        if (k + 1) % 5 == 0:
+            print(f"{k + 1}/{args.rounds} rounds, {total} chars, "
+                  f"{time.time() - t0:.0f}s", flush=True)
+    print(f"sp fuzz OK: {args.rounds} rounds, {total} chars, "
+          f"{time.time() - t0:.0f}s", flush=True)
+
+
+if __name__ == "__main__":
+    main()
