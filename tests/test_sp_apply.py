@@ -405,6 +405,50 @@ class TestSpRemote:
         doc.apply_stream(combined)
         assert doc.expand().tolist() == oracle_signed(oracle)
 
+    def test_snapshot_load_tables_then_remote(self):
+        # The documented snapshot path: build a doc on one SpDoc,
+        # transfer (runs + by-order tables) to a FRESH SpDoc via
+        # load/load_tables, then apply REMOTE ops that probe the
+        # pre-snapshot history — must equal the oracle.
+        from text_crdt_rust_tpu.common import (
+            RemoteDel, RemoteId, RemoteIns, RemoteTxn)
+        from text_crdt_rust_tpu.models.oracle import ListCRDT
+
+        ROOT = RemoteId("ROOT", 0xFFFFFFFF)
+        base = [RemoteTxn(id=RemoteId("amy", 0), parents=[],
+                          ops=[RemoteIns(ROOT, ROOT, "hello world")])]
+        later = [
+            RemoteTxn(id=RemoteId("bob", 0), parents=[RemoteId("amy", 10)],
+                      ops=[RemoteIns(RemoteId("amy", 4),
+                                     RemoteId("amy", 5), "XY"),
+                           RemoteDel(RemoteId("amy", 0), 3)]),
+        ]
+        oracle = ListCRDT()
+        for t in base + later:
+            oracle.apply_remote_txn(t)
+
+        src = sp_doc(shard_rows=32)
+        table = B.AgentTable()
+        for t in base + later:
+            table.add(t.id.agent)
+            for op in t.ops:
+                if hasattr(op, "id"):
+                    table.add(op.id.agent)
+        ops_base, assigner = B.compile_remote_txns(base, table,
+                                                   lmax=16, dmax=None)
+        src.apply_stream(ops_base)
+
+        dst = sp_doc(shard_rows=32)
+        o, ln = src.runs()
+        dst.load(o, ln)
+        dst.load_tables(np.asarray(src.oll), np.asarray(src.orl),
+                        np.asarray(src.rkl))
+        ops_later, _ = B.compile_remote_txns(later, table,
+                                             assigner=assigner,
+                                             lmax=16, dmax=None)
+        dst.apply_stream(ops_later)
+        assert dst.expand().tolist() == oracle_signed(oracle)
+
     def test_missing_order_raises(self):
         from text_crdt_rust_tpu.common import (
             RemoteDel, RemoteId, RemoteIns, RemoteTxn)
