@@ -436,7 +436,14 @@ def cfg_northstar(args):
         patches = patches[:args.patches]
     n_ops = len(patches)
     ins_total = sum(len(p.ins_content) for p in patches)
-    batch = args.batch or (256 if args.engine == "rle" else 128)
+    # Default geometry (rle): 512 lanes at the measured-optimum capacity
+    # 20,992 (r5 sweep). A user-supplied LARGER --capacity falls back to
+    # 256 lanes: 512-lane planes exceed the VMEM budget at 32k+ rows
+    # (PERF.md §5).
+    _rle_cap = args.capacity or 20992
+    batch = args.batch or (
+        (512 if _rle_cap <= 20992 else 256)
+        if args.engine == "rle" else 128)
 
     base_ops, base_str = native_replay(patches)
     # Full-trace ground truth is shipped with the corpus; the O(n^2)
@@ -450,7 +457,9 @@ def cfg_northstar(args):
         merged = B.merge_patches(patches)
         lmax = max([len(p.ins_content) for p in merged] + [1])
         ops, _ = B.compile_local_patches(merged, lmax=lmax, dmax=None)
-        # K=128 x 256 lanes is the measured VMEM optimum (PERF.md §5);
+        # K=128 x 512 lanes x capacity 20,992 is the measured optimum
+        # (r5 sweep, committed as perf/sweep_r4.json — written by
+        # perf/sweep_r4.py: 3.80G ops/s vs 2.63G at the old 256x32768);
         # the HBM variant holds 1024+ lanes (verdict item 2's batch bar)
         # and G doc GROUPS multiply the concurrent-document count to the
         # 10k of the north-star statement in ONE kernel launch.
@@ -463,7 +472,7 @@ def cfg_northstar(args):
             maker = partial(RH.make_replayer_rle_hbm, block_k=block_k)
         else:
             block_k = 128
-            capacity = args.capacity or 32768  # RUN rows, not chars
+            capacity = args.capacity or 20992  # RUN rows, not chars
             capacity = ((capacity + block_k - 1) // block_k) * block_k
             maker = partial(R.make_replayer_rle, block_k=block_k)
         log(f"[northstar] {args.trace}[:{n_ops}] -> {ops.num_steps} merged "
@@ -1153,7 +1162,8 @@ def main() -> None:
                     help="northstar trace prefix (0 = FULL trace)")
     ap.add_argument("--batch", type=int, default=0,
                     help="identical-doc lanes (0 = per-config default: "
-                         "northstar 256, others 128)")
+                         "northstar 512 at capacity <= 20992 else 256, "
+                         "others 128)")
     ap.add_argument("--lmax", type=int, default=16)
     ap.add_argument("--engine", choices=ENGINE_CHOICES, default="rle")
     ap.add_argument("--groups", type=int, default=1,
@@ -1163,8 +1173,9 @@ def main() -> None:
                     help="kevin TPU prepend count (default = the full "
                          "reference workload, benches/yjs.rs:51-62)")
     ap.add_argument("--capacity", type=int, default=0,
-                    help="rle engine run-row capacity (0 = default 32768; "
-                         "rounded up to a 256-row block multiple)")
+                    help="rle engine run-row capacity (0 = default 20992 "
+                         "for rle, 32768 for rle-hbm; rounded up to a "
+                         "block_k multiple)")
     ap.add_argument("--block-k", type=int, default=512)
     ap.add_argument("--chunk", type=int, default=1024)
     ap.add_argument("--reps", type=int, default=5)

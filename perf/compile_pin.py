@@ -7,11 +7,12 @@ Run on the real chip (no full replay, no timing):
 AOT-compiles (jit .lower().compile(); nothing executes) every geometry
 the committed BENCH_ALL.json depends on (VERDICT r4 weak #5: the shapes
 the headline rows rely on had no standing compile check) — the
-northstar batch-256/384 shapes at BOTH the default capacity 32768 and
-the measured-optimum 20992, the config-2 measured-capacity shape, the
-config-4 storm at the lifted 256-lane width, the kevin HBM shape, and
-the config-5 per-lane engines (local + remote/mixed).  Exits non-zero
-naming the first geometry that fails.
+northstar default b512/k128/cap20992 (the r5 measured optimum) plus the
+b256/b384 shapes at 32768 and 20992, the config-2 measured-capacity
+shape, the config-4 storm at the lifted 256-lane width, the kevin HBM
+shape exactly as cfg_kevin launches it (b128/k2048, store_origins off),
+and the config-5 per-lane engines (local + remote/mixed).  Exits
+non-zero naming the first geometry that fails.
 """
 import sys
 import time
@@ -88,11 +89,17 @@ def main():
         return build
 
     def kevin_hbm():
+        # The geometry the committed kevin_tpu row actually uses
+        # (cfg_kevin): 128-lane tiles (Mosaic rejects 64-lane HBM-plane
+        # slices), block_k=2048, origin outputs dropped.
         from text_crdt_rust_tpu.ops import rle_hbm as RH
         ops, _ = B.compile_local_patches(
             [TestPatch(0, 0, " ")] * 64, lmax=1, dmax=None)
+        # capacity = cfg_kevin's formula at kevin_n=5M:
+        # ((int(5e6 * 2.1) + 2047) // 2048) * 2048
         aot(lambda: RH.make_replayer_rle_hbm(
-            ops, capacity=10506240, batch=64, block_k=512, chunk=1024))
+            ops, capacity=10500096, batch=128, block_k=2048, chunk=1024,
+            store_origins=False))
 
     def lanes_local():
         # The config-5 local shape: 2048 divergent lanes, tile 512.
@@ -115,13 +122,14 @@ def main():
     dev = jax.devices()[0]
     print(f"device: {dev.platform} {dev.device_kind}", flush=True)
     results = [
+        pin("northstar b512/k128/cap20992", northstar(512, 20992)),
         pin("northstar b256/k128/cap32768", northstar(256, 32768)),
         pin("northstar b256/k128/cap20992", northstar(256, 20992)),
         pin("northstar b384/k128/cap20992", northstar(384, 20992)),
         pin("config2 b128/k256/cap36096", config2),
         pin("rle-mixed storm b128/k128", storm(128)),
         pin("rle-mixed storm b256/k128", storm(256)),
-        pin("kevin rle-hbm b64/k512/cap10.5M", kevin_hbm),
+        pin("kevin rle-hbm b128/k2048/cap10.5M", kevin_hbm),
         pin("rle-lanes cfg5 b2048/t512/cap1664", lanes_local),
         pin("rle-lanes-mixed cfg5r b2048/t256/cap3328", lanes_mixed),
     ]

@@ -322,12 +322,18 @@ def _mixed_lanes_kernel(
             off = c - (_vrow(cumraw, i_r) - l_r)
             return o_r, l_r, off
 
+        # Loop-carried lane masks ride as i32 0/1: Mosaic materializes
+        # loop-carried [1, T] i1 vectors as i8 and has no i8->i1
+        # truncation, so a bool carry fails to compile on real TPU
+        # (the cfg5r MosaicError in perf/compile_pin_r5.log).
         def cond(state):
-            cursor, scanning, scan_start, done = state
-            return jnp.any(~done & (cursor < n))
+            cursor, scanning_i, scan_start, done_i = state
+            return jnp.any((done_i == 0) & (cursor < n))
 
         def body(state):
-            cursor, scanning, scan_start, done = state
+            cursor, scanning_i, scan_start, done_i = state
+            scanning = scanning_i != 0
+            done = done_i != 0
             o_r, l_r, off = run_at_raw(cursor)
             so = jnp.abs(o_r) - 1
             other_order = so + off
@@ -343,23 +349,29 @@ def _mixed_lanes_kernel(
             starts_scan = eq & ~gt & (o_right != other_right)
             new_scan_start = jnp.where(
                 live & starts_scan & ~scanning, cursor, scan_start)
-            new_scanning = jnp.where(
+            # i32-VALUED selects: a vector select whose RESULTS are i1
+            # makes Mosaic round-trip the mask through i8 (the trunci
+            # MosaicError); selecting 0/1 i32 keeps it on the vreg path.
+            new_scanning_i = jnp.where(
                 live & eq,
-                jnp.where(gt, False,
-                          jnp.where(o_right == other_right, scanning,
-                                    True)),
-                scanning)
+                jnp.where(gt, 0,
+                          jnp.where(o_right == other_right, scanning_i,
+                                    1)),
+                scanning_i)
             contains_right = (o_right > other_order) & (o_right < so + l_r)
             step = jnp.where(contains_right, o_right - other_order,
                              l_r - off)
             new_cursor = jnp.where(live & ~brk, cursor + step, cursor)
-            return (new_cursor, new_scanning, new_scan_start,
-                    done | brk | (cursor >= n))
+            new_done_i = jnp.maximum(
+                done_i, jnp.where(brk | (cursor >= n), 1, 0))
+            return (new_cursor, new_scanning_i, new_scan_start,
+                    new_done_i)
 
-        f = jnp.zeros_like(cursor0) != 0  # [1, B] False
-        init = (cursor0, f, cursor0, ~act)
-        cursor, scanning, scan_start, _ = lax.while_loop(cond, body, init)
-        return jnp.where(scanning, scan_start, cursor), cumraw
+        zero = jnp.zeros_like(cursor0)  # [1, B] i32 False
+        init = (cursor0, zero, cursor0, (~act).astype(jnp.int32))
+        cursor, scanning_i, scan_start, _ = lax.while_loop(
+            cond, body, init)
+        return jnp.where(scanning_i != 0, scan_start, cursor), cumraw
 
     def do_remote_insert(act, k, my_rank, o_left, o_right, il, st):
         flag_capacity(act)
