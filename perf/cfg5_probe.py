@@ -1,0 +1,78 @@
+"""Isolate the config-5 per-step regression (r4 1.30ms -> r5 6.07ms).
+
+Times ONE cfg_5-shaped chunk (100 steps x 2048 divergent lanes) on the
+attached chip, with the round-5 shared-cum hoist ON (the gate's choice)
+and FORCED OFF (the r4 kernel's per-branch cumsum), at the final
+capacity 1664 and at a mid-stream growing capacity 1024.
+
+    python perf/cfg5_probe.py
+"""
+import random
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import numpy as np
+
+from text_crdt_rust_tpu.ops import batch as B
+from text_crdt_rust_tpu.ops import rle_lanes as RL
+from text_crdt_rust_tpu.utils.testdata import TestPatch
+
+
+def continue_patches(rng, content, steps, ins_prob=0.45):
+    patches = []
+    for _ in range(steps):
+        if not content or rng.random() < ins_prob:
+            pos = rng.randint(0, len(content))
+            ins = "".join(rng.choice("abcdefgh ")
+                          for _ in range(rng.randint(1, 4)))
+            patches.append(TestPatch(pos, 0, ins))
+            content = content[:pos] + ins + content[pos:]
+        else:
+            pos = rng.randint(0, len(content) - 1)
+            span = min(rng.randint(1, 4), len(content) - pos)
+            patches.append(TestPatch(pos, span, ""))
+            content = content[:pos] + content[pos + span:]
+    return patches, content
+
+
+def main():
+    n_docs, steps = 2048, 100
+    rngs = [random.Random(1000 + d) for d in range(n_docs)]
+    contents = [""] * n_docs
+    opses = []
+    for d in range(n_docs):
+        patches, contents[d] = continue_patches(rngs[d], contents[d],
+                                                steps)
+        ops, _ = B.compile_local_patches(patches, lmax=4, dmax=None)
+        opses.append(ops)
+    stacked = B.stack_ops(opses)
+
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} {dev.device_kind}", flush=True)
+
+    real_gate = RL._shared_cum_gate
+    for cap in (1664, 1024):
+        for mode, gate in (("gated", real_gate),
+                           ("off", lambda *a: False),
+                           ("on", lambda *a: True)):
+            RL._shared_cum_gate = gate
+            RL._build_call.cache_clear()
+            run = RL.make_replayer_lanes(stacked, capacity=cap,
+                                         chunk=128)
+            np.asarray(run().err)  # compile + warm
+            t0 = time.perf_counter()
+            reps = 5
+            for _ in range(reps):
+                res = run()
+            np.asarray(res.err)
+            dt = (time.perf_counter() - t0) / reps
+            print(f"cap={cap} shared_cum={mode}: {dt*1e3:.1f}ms/chunk "
+                  f"({dt/steps*1e6:.0f}us/step)", flush=True)
+    RL._shared_cum_gate = real_gate
+
+
+if __name__ == "__main__":
+    main()

@@ -111,12 +111,14 @@ def main():
 
     def lanes_mixed():
         # The config-5 REMOTE shape: 2048 divergent remote lanes,
-        # tile 256, run planes + by-order tables.
+        # tile 256, run planes + by-order tables, at the FINAL growing
+        # capacity the committed cfg5r row records (capacity 2688,
+        # order_capacity 3208 — BENCH_ALL.json).
         from text_crdt_rust_tpu.ops import rle_lanes_mixed as RLM
         ops, _ = B.compile_local_patches(merged[:4], lmax=4, dmax=None)
         stacked = B.stack_ops([ops] * 2048)
         aot(lambda: RLM.make_replayer_lanes_mixed(
-            stacked, capacity=3328, order_capacity=3208,
+            stacked, capacity=2688, order_capacity=3208,
             chunk=128, lane_tile=256))
 
     dev = jax.devices()[0]
@@ -131,7 +133,7 @@ def main():
         pin("rle-mixed storm b256/k128", storm(256)),
         pin("kevin rle-hbm b128/k2048/cap10.5M", kevin_hbm),
         pin("rle-lanes cfg5 b2048/t512/cap1664", lanes_local),
-        pin("rle-lanes-mixed cfg5r b2048/t256/cap3328", lanes_mixed),
+        pin("rle-lanes-mixed cfg5r b2048/t256/cap2688", lanes_mixed),
     ]
     if not all(results):
         sys.exit(1)
