@@ -1,0 +1,53 @@
+"""Config-4 storm cost model: where do the 43us/step go?
+
+Replays the 16-peer concurrent-insert storm on the rle_mixed engine at
+several ROUND counts and lane widths.  If wall grows ~quadratically in
+rounds, the YATA scan's run-walk dominates (iterations ~ peers x
+rounds per op); if ~linearly, the fixed per-step cost does.
+
+    python perf/cfg4_probe.py
+"""
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import numpy as np
+
+from text_crdt_rust_tpu.ops import batch as B
+from text_crdt_rust_tpu.ops import rle_mixed as RM
+from text_crdt_rust_tpu.utils.randedit import make_storm
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} {dev.device_kind}", flush=True)
+    for rounds in (50, 100, 200):
+        txns, receiver = make_storm(16, rounds, 4, seed=7)
+        table = B.AgentTable(sorted({t.id.agent for t in txns}))
+        ops, _ = B.compile_remote_txns(txns, table, lmax=8, dmax=16)
+        n_chars = 16 * rounds * 4
+        block_k = 128
+        capacity = ((max(int(ops.num_steps * 3), 256) + block_k - 1)
+                    // block_k) * block_k
+        for batch in (128,) if rounds != 200 else (128, 256):
+            run = RM.make_replayer_rle_mixed(
+                ops, capacity=capacity, batch=batch, block_k=block_k,
+                chunk=1024)
+            res = run()
+            np.asarray(res.err)  # compile + warm
+            t0 = time.perf_counter()
+            reps = 3
+            for _ in range(reps):
+                res = run()
+            np.asarray(res.err)
+            dt = (time.perf_counter() - t0) / reps
+            print(f"rounds={rounds} steps={ops.num_steps} b={batch} "
+                  f"cap={capacity}: {dt*1e3:.1f}ms "
+                  f"({dt/ops.num_steps*1e6:.1f}us/step, "
+                  f"{n_chars/dt:,.0f} chars/s)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -122,13 +122,47 @@ def _insert_splice(bo, bl, idx_k, p, i_r, o_r, l_r, off, il, st):
     return no, nl, amt, mrg, is_split
 
 
-def _delete_block_math(bo, bl, idx_k, K, base, p, rem):
+def _split_piece_aux(aux, idx_k, i_p, amt, w1, w2, so0, s_off, e_off,
+                     has_head):
+    """Aux-plane transform shared by every 3-way run split ([head?]
+    [mid] [tail?] — delete boundaries and remote-delete endpoint
+    retires): pieces after the first chain to their own predecessor
+    char (`span.rs:24-28` implicit chain survives splits), their
+    origin-right is poisoned with -2 (unknowable from the head:
+    merge-appended chars keep their own; ops/rle_mixed.py falls back
+    to the serial walk if such a piece ever classifies as a sibling),
+    and rank is inherited (runs are single-agent).  ``so0`` is the
+    run's 0-based start order; pieces begin at ``so0 + s_off`` /
+    ``so0 + e_off``.  Returns the three transformed planes."""
+    olp_b, orp_b, rkp_b = aux
+    t_rk = _row_scalar(rkp_b, i_p, idx_k)
+    sent = jnp.int32(-2)
+    p1_ol = jnp.where(has_head, so0 + s_off - 1, so0 + e_off - 1)
+    p2_ol = so0 + e_off - 1
+    out = []
+    for a, v1, v2 in ((olp_b, p1_ol, p2_ol), (orp_b, sent, sent),
+                      (rkp_b, t_rk, t_rk)):
+        na = jnp.where(idx_k <= i_p, a, _shift_rows(a, amt, 2))
+        na = jnp.where(w1, v1, na)
+        na = jnp.where(w2, v2, na)
+        out.append(na)
+    return tuple(out)
+
+
+def _delete_block_math(bo, bl, idx_k, K, base, p, rem, aux=None):
     """One delete iteration over one block (`mutations.rs:520-570`): flip
     fully-covered runs, split at most the two boundary runs. Returns
     ``(no, nl, added_rows, covered)``; caller walks blocks while
-    ``covered`` hasn't reached ``rem``."""
+    ``covered`` hasn't reached ``rem``.
 
-    def apply_partial(active, i_p, cs, ce, bo, bl):
+    ``aux`` (optional) is a tuple of per-run head-metadata planes
+    (origin-left, origin-right, rank — the ``rle_mixed`` YATA fast-path
+    cache); split pieces inherit their run's origin-right/rank, and a
+    non-first piece's head chains to its own predecessor char (the
+    `span.rs:24-28` implicit chain survives splits). Returns the
+    transformed aux as a 5th element when given."""
+
+    def apply_partial(active, i_p, cs, ce, bo, bl, aux):
         o = _row_scalar(bo, i_p, idx_k)
         ln = _row_scalar(bl, i_p, idx_k)
         cs_i = _row_scalar(cs, i_p, idx_k)
@@ -156,7 +190,12 @@ def _delete_block_math(bo, bl, idx_k, K, base, p, rem):
         w2 = active & (idx_k == i_p + 2) & (amt == 2)
         no = jnp.where(w2, o + ce_i, no)
         nl = jnp.where(w2, ln - ce_i, nl)
-        return no, nl, amt
+        if aux is None:
+            return no, nl, amt, None
+        # Partial covers only reach LIVE runs: o > 0, start order o-1.
+        # Piece 0 keeps the original head (its aux row is untouched).
+        return no, nl, amt, _split_piece_aux(
+            aux, idx_k, i_p, amt, w1, w2, o - 1, cs_i, ce_i, has_head)
 
     lv = jnp.where(bo > 0, bl, 0)
     cum = _cumsum_rows(lv)
@@ -173,9 +212,11 @@ def _delete_block_math(bo, bl, idx_k, K, base, p, rem):
 
     bo = jnp.where(full, -bo, bo)
     # Higher-index boundary first so i1's row index stays valid.
-    bo, bl, a2 = apply_partial(npart >= 1, i2, cs, ce, bo, bl)
-    bo, bl, a1 = apply_partial(npart == 2, i1, cs, ce, bo, bl)
-    return bo, bl, a1 + a2, tot
+    bo, bl, a2, aux = apply_partial(npart >= 1, i2, cs, ce, bo, bl, aux)
+    bo, bl, a1, aux = apply_partial(npart == 2, i1, cs, ce, bo, bl, aux)
+    if aux is None:
+        return bo, bl, a1 + a2, tot
+    return bo, bl, a1 + a2, tot, aux
 
 
 def _rle_kernel(

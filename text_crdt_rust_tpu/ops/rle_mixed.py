@@ -69,6 +69,7 @@ from .rle import (
     _locate_run,
     _row_scalar,
     _shift_rows_up,
+    _split_piece_aux,
 )
 from .span_arrays import make_flat_doc
 
@@ -148,8 +149,10 @@ def _mixed_rle_kernel(
     blk_out, rows_out, meta_out, err_ref,       # tables + flags
     blkord, rws, liv, raw, cumliv, cumraw,      # VMEM scratch (cum* =
     ordblk, oll, orl,                           #   incremental inclusive
-    meta,                                       #   prefixes; SMEM scratch
+    olp, orp, rkp, lpp,                         #   prefixes; per-run YATA
+    meta,                                       #   aux planes; SMEM scratch
     *, K: int, NB: int, NBL: int, CHUNK: int, OT: int,
+    FAST: bool = True,
 ):
     B = ordp.shape[1]
     CAP = K * NB
@@ -181,6 +184,16 @@ def _mixed_rle_kernel(
         err_ref[:] = jnp.zeros_like(err_ref)
         oll[:] = oll_in[:]
         orl[:] = orl_in[:]
+        # Per-run YATA aux planes (the vectorized conflict scan's
+        # gather-free cache): origin-left / origin-right / author rank
+        # of each run's HEAD char, plus the logical position of each
+        # row's block (the doc-order sort key).  Maintained through
+        # every splice; split pieces inherit or/rank and chain ol to
+        # their predecessor (`span.rs:24-28` implicit chain).
+        olp[:] = jnp.zeros_like(olp)
+        orp[:] = jnp.zeros_like(orp)
+        rkp[:] = jnp.zeros_like(rkp)
+        lpp[:] = jnp.zeros_like(lpp)
         meta[0] = 1  # logical blocks in use
 
     # ---- by-order tables (order o lives at [o // 128, o % 128]) ---------
@@ -278,6 +291,19 @@ def _mixed_rle_kernel(
             keep_mask = idx_k < keep
             ordp[pl.ds(b * K, K), :] = jnp.where(keep_mask, bo, 0)
             lenp[pl.ds(b * K, K), :] = jnp.where(keep_mask, bl, 0)
+            # Aux planes move with their rows (values unchanged: a
+            # block split never changes any run's head char).
+            for ap in (olp, orp, rkp):
+                ax = ap[pl.ds(b * K, K), :]
+                ap[pl.ds(nb * K, K), :] = jnp.where(
+                    new_mask, _shift_rows_up(ax, keep, K), 0)
+                ap[pl.ds(b * K, K), :] = jnp.where(keep_mask, ax, 0)
+            # Logical positions: blocks after slot l shift one slot
+            # down; the moved-out top half (new physical block nb)
+            # lands at slot l + 1.  (Unallocated blocks' rows hold
+            # 0, never > l, so the shift cannot touch them.)
+            lpp[:] = jnp.where(lpp[:] > l, lpp[:] + 1, lpp[:])
+            lpp[:] = jnp.where(idx_cap // K == nb, l + 1, lpp[:])
 
             # cum prefixes shift with the tables; slot l+1 inherits the
             # old inclusive prefix of l (correct), slot l loses the
@@ -357,19 +383,10 @@ def _mixed_rle_kernel(
 
     def run_at_raw(c):
         """Signed start order, length, and 0-based char offset of the run
-        holding RAW position ``c``."""
-        l = slot_of_cum(RAW, c + 1)
-        b = slot_scalar(blkord, l)
-        r0 = slot_scalar(rws, l)
-        local = c - sum_before_slot(RAW, l)
-        bo = ordp[pl.ds(b * K, K), :]
-        bl = lenp[pl.ds(b * K, K), :]
-        cum = _cumsum_rows(bl)
-        i_r = jnp.max(jnp.sum(
-            ((cum <= local) & (idx_k < r0)).astype(jnp.int32), axis=0))
-        o_r = _row_scalar(bo, i_r, idx_k)
-        l_r = _row_scalar(bl, i_r, idx_k)
-        off = local - (_row_scalar(cum, i_r, idx_k) - l_r)
+        holding RAW position ``c`` (one shared location routine —
+        ``run_at2`` — so the serial walk and the fast scan's window
+        bounds can never desynchronize)."""
+        _, _, _, o_r, l_r, off = run_at2(c)
         return o_r, l_r, off
 
     # ---- local ops (the ops.rle paths + raw/index/table upkeep) ---------
@@ -388,6 +405,30 @@ def _mixed_rle_kernel(
             left.astype(jnp.uint32), (1, B))
         or_ref[pl.ds(k, 1), :] = jnp.broadcast_to(
             right.astype(jnp.uint32), (1, B))
+
+    def aux_splice(b, i_r, ins_at, amt, mrg, is_split, tail_ol,
+                   new_ol, new_or, new_rk):
+        """Mirror an insert splice's row motion onto the per-run YATA
+        aux planes of block ``b``: rows >= ``ins_at`` shift down by
+        ``amt``, the new run takes the op's (origin-left, origin-right,
+        rank), and a split tail chains to its own predecessor char
+        while inheriting the split run's origin-right/rank."""
+        ao = olp[pl.ds(b * K, K), :]
+        ar = orp[pl.ds(b * K, K), :]
+        ak = rkp[pl.ds(b * K, K), :]
+        t_rk = _row_scalar(ak, i_r, idx_k)
+        new_run = (idx_k == ins_at) & jnp.logical_not(mrg)
+        tail = is_split & (idx_k == ins_at + 1)
+        # A split tail's origin-right is NOT the head's (merge-appended
+        # chars keep their own); -2 marks it unknowable -> any sibling
+        # classification of such a piece falls back to the serial walk.
+        for ap, a, nv, tv in ((olp, ao, new_ol, tail_ol),
+                              (orp, ar, new_or, jnp.int32(-2)),
+                              (rkp, ak, new_rk, t_rk)):
+            na = jnp.where(idx_k < ins_at, a, _shift_rows(a, amt, 2))
+            na = jnp.where(new_run, nv, na)
+            na = jnp.where(tail, tv, na)
+            ap[pl.ds(b * K, K), :] = na
 
     def do_local_insert(k, p, il, st):
         """Insert an ``il``-char run after LIVE rank ``p``
@@ -427,6 +468,9 @@ def _mixed_rle_kernel(
         right = jnp.where(succ == 0, root_i,
                           (jnp.abs(succ) - 1).astype(jnp.int32))
 
+        aux_splice(b, i_r, jnp.where(p == 0, 0, i_r + 1), amt, _mrg,
+                   is_split, (o_r - 1) + off - 1, left, right,
+                   tab_read(rkl_in, st))
         ordp[pl.ds(b * K, K), :] = no
         lenp[pl.ds(b * K, K), :] = nl
         rws[pl.ds(l, 1), :] = rws[pl.ds(l, 1), :] + amt
@@ -453,8 +497,13 @@ def _mixed_rle_kernel(
             base = sum_before_slot(LIV, l)
             bo = ordp[pl.ds(b * K, K), :]
             bl = lenp[pl.ds(b * K, K), :]
-            no, nl, added, tot = _delete_block_math(
-                bo, bl, idx_k, K, base, p, rem)
+            aux_in = (olp[pl.ds(b * K, K), :],
+                      orp[pl.ds(b * K, K), :],
+                      rkp[pl.ds(b * K, K), :])
+            no, nl, added, tot, aux_out = _delete_block_math(
+                bo, bl, idx_k, K, base, p, rem, aux=aux_in)
+            for ap, na in zip((olp, orp, rkp), aux_out):
+                ap[pl.ds(b * K, K), :] = na
             ordp[pl.ds(b * K, K), :] = no
             lenp[pl.ds(b * K, K), :] = nl
             rws[pl.ds(l, 1), :] = rws[pl.ds(l, 1), :] + added
@@ -471,14 +520,144 @@ def _mixed_rle_kernel(
 
     # ---- remote insert (`doc.rs:274-293` -> integrate) ------------------
 
-    def integrate_cursor(my_rank, o_left, o_right):
+    def run_at2(c):
+        """``run_at_raw`` + the run's (logical slot, physical block,
+        row): everything the fast scan's window bounds need."""
+        l = slot_of_cum(RAW, c + 1)
+        b = slot_scalar(blkord, l)
+        r0 = slot_scalar(rws, l)
+        local = c - sum_before_slot(RAW, l)
+        bo = ordp[pl.ds(b * K, K), :]
+        bl = lenp[pl.ds(b * K, K), :]
+        cum = _cumsum_rows(bl)
+        i_r = jnp.max(jnp.sum(
+            ((cum <= local) & (idx_k < r0)).astype(jnp.int32), axis=0))
+        o_r = _row_scalar(bo, i_r, idx_k)
+        l_r = _row_scalar(bl, i_r, idx_k)
+        off = local - (_row_scalar(cum, i_r, idx_k) - l_r)
+        return l, b, i_r, o_r, l_r, off
+
+    BIGK = NBL * K + K  # past any valid doc-order key
+
+    def integrate_fast(cursor0, my_rank, o_left, o_right):
+        """Vectorized YATA conflict scan: ONE classification pass over
+        all run rows plus three masked reductions replace the serial
+        run-walk (whose per-op cost grows with the document and
+        dominated the config-4 storm).
+
+        Sound when every run in the scan window is either a direct
+        SIBLING (head ``origin_left`` == the op's — order equality, so
+        ``olc == left_cursor`` exactly) or a PHYSICALLY-CHAINED piece
+        (head chains to its own predecessor char, which sits in the
+        previous row of the same block — then ``olc == head position >=
+        left_cursor``, the serial walk's plain advance).  Anything else
+        — including a split piece whose by-order predecessor was
+        spliced away from it — raises ``flag`` and the caller falls
+        back to the exact serial loop, so exotic windows lose speed,
+        never correctness.  The scanning/scan_start state machine
+        (`doc.rs:183-222`, pinned-scan_start rule) reduces to:
+
+          kfb = first sibling that breaks (rank > mine, same o_right)
+          kll = last lower-ranked sibling before kfb
+          kss = first higher-ranked different-o_right sibling after kll
+          cursor = kss if it exists else kfb (else the o_right bound)
+        """
+        n = total_of(RAW)
+        tpos = jnp.where(o_right == root_i, n, pos_of_order(o_right))
+        # Window bounds as doc-order keys (logical slot * K + row).
+        l0, b0, i0, o_r0, l_r0, off0 = run_at2(cursor0)
+        key_lo = l0 * K + i0 - jnp.where(off0 == 0, 1, 0)
+        lT, bT, iT, o_rT, l_rT, offT = run_at2(tpos)
+        key_hi = jnp.where(tpos >= n, BIGK,
+                           lT * K + iT + jnp.where(offT == 0, 0, 1))
+        key = lpp[:] * K + idx_cap % K
+        valid = ordp[:] != 0
+        W = valid & (key > key_lo) & (key < key_hi)
+        h = jnp.abs(ordp[:]) - 1
+        S = W & (olp[:] == o_left)
+        # Chained piece whose predecessor char (order h-1) is literally
+        # the previous row's last char: olc = own head position, a
+        # plain advance.  Row 0 of a block cannot verify adjacency
+        # (its predecessor row lives in another block) -> not safe.
+        e_prev = pltpu.roll(h + lenp[:] - 1, 1, axis=0)
+        rib = idx_cap % K
+        chain = (W & ~S & (h > 0) & (olp[:] == h - 1)
+                 & (rib > 0) & (e_prev == h - 1))
+        bad = (W & ~S & ~chain) | (S & ((rkp[:] == my_rank)
+                                        | (orp[:] == -2)))
+        gt_r = rkp[:] > my_rank
+        sgo = S & gt_r & (orp[:] == o_right)
+        sgn = S & gt_r & (orp[:] != o_right)
+        slt = S & ~gt_r
+        kfb = jnp.min(jnp.where(sgo, key, BIGK))
+        kll = jnp.max(jnp.where(slt & (key < kfb), key, -1))
+        kss = jnp.min(jnp.where(sgn & (key > kll) & (key < kfb), key,
+                                BIGK))
+        flag = jnp.max(jnp.where(bad, 1, 0)) > 0
+
+        # Mid-run window start: the char AT cursor0 chains to the char
+        # at cursor0 - 1 == the op's origin_left char, so it is always
+        # a direct sibling (the serial walk probes it at off > 0); its
+        # key precedes every window key.
+        pseudo = (off0 > 0) & (cursor0 < tpos)
+        # The pseudo candidate is a MID-RUN char: its origin-right and
+        # rank come from the exact by-order tables (the serial walk's
+        # source), not the head aux — merge-appended chars keep their
+        # own origin-right.
+        order0 = jnp.clip(jnp.abs(o_r0) - 1 + off0, 0, OT * LANES - 1)
+        p_or = tab_read(orl, order0)
+        p_rk = tab_read(rkl_in, order0)
+        kP = key_lo  # strictly below every window key
+        p_gt = p_rk > my_rank
+        flag = flag | (pseudo & (p_rk == my_rank))
+        kfb = jnp.where(pseudo & p_gt & (p_or == o_right), kP, kfb)
+        kll = jnp.where(pseudo & ~p_gt & (kP < kfb) & (kll < 0), kP, kll)
+        kss = jnp.where(pseudo & p_gt & (p_or != o_right)
+                        & (kll < kP) & (kP < kfb), kP, kss)
+
+        # kss was reduced against the PRE-pseudo kfb; if the pseudo
+        # candidate lowered kfb (it precedes every window key), a stale
+        # window kss must lose to it — compare against kfb, not BIGK.
+        kwin = jnp.where(kss < kfb, kss, kfb)
+        # Winner position: tpos when nothing broke earlier, the window
+        # start for the pseudo candidate, else the winning run head's
+        # raw position (one block read).
+        l_w = jnp.clip(kwin // K, 0, NBL - 1)
+        i_w = kwin % K
+        b_w = slot_scalar(blkord, l_w)
+        bl_w = lenp[pl.ds(b_w * K, K), :]
+        hp_w = sum_before_slot(RAW, l_w) + _lane_scalar(
+            jnp.where(idx_k < i_w, bl_w, 0))
+        c = jnp.where(kwin >= BIGK, tpos,
+                      jnp.where(kwin == kP, cursor0, hp_w))
+        return c, flag
+
+    def integrate_entry(my_rank, o_left, o_right):
+        cursor0 = cursor_after(o_left)
+        if not FAST:
+            return integrate_cursor(cursor0, my_rank, o_left, o_right)
+        c_fast, flag = integrate_fast(cursor0, my_rank, o_left, o_right)
+        # Branch via pl.when + an SMEM cell, not lax.cond: a cond whose
+        # branch nests the serial while-loop (with its ref writes) sends
+        # Mosaic compilation into the weeds (>7 min for the storm
+        # kernel vs ~20s with predication).
+        meta[1] = c_fast
+
+        @pl.when(flag)
+        def _exact():
+            meta[1] = integrate_cursor(cursor0, my_rank, o_left, o_right)
+
+        return meta[1]
+
+    def integrate_cursor(cursor0, my_rank, o_left, o_right):
         """The YATA conflict scan (`doc.rs:183-222`) over RUNS: a run's
         non-head chars have ``origin_left == own predecessor`` (olc ==
         own position > left_cursor), so after evaluating a head char the
         scan can only stop inside that run AT ``o_right`` — each
         iteration consumes a whole run or jumps straight there.
-        Pinned-scan_start rule (tests/test_integrate_divergence.py)."""
-        cursor0 = cursor_after(o_left)
+        Pinned-scan_start rule (tests/test_integrate_divergence.py).
+        The serial exact path: ``integrate_fast`` replaces it whenever
+        the window shape allows, falling back here via ``flag``."""
         left_cursor = cursor0
         n = total_of(RAW)
 
@@ -521,7 +700,7 @@ def _mixed_rle_kernel(
         return jnp.where(scanning, scan_start, cursor)
 
     def do_remote_insert(k, my_rank, o_left, o_right, il, st):
-        c = integrate_cursor(my_rank, o_left, o_right)
+        c = integrate_entry(my_rank, o_left, o_right)
         l = jnp.where(c == 0, 0, slot_of_cum(RAW, c))
 
         @pl.when(slot_scalar(rws, l) + 2 > K)
@@ -537,6 +716,9 @@ def _mixed_rle_kernel(
         i_r, o_r, l_r, off = _locate_run_raw(bo, bl, idx_k, r0, local)
         no, nl, amt, _mrg, _is_split = _insert_splice_raw(
             bo, bl, idx_k, c, i_r, o_r, l_r, off, il, st, o_left)
+        aux_splice(b, i_r, jnp.where(c == 0, 0, i_r + 1), amt, _mrg,
+                   _is_split, (jnp.abs(o_r) - 1) + off - 1,
+                   o_left, o_right, my_rank)
         ordp[pl.ds(b * K, K), :] = no
         lenp[pl.ds(b * K, K), :] = nl
         rws[pl.ds(l, 1), :] = rws[pl.ds(l, 1), :] + amt
@@ -608,6 +790,15 @@ def _mixed_rle_kernel(
             nl = jnp.where(w2, l_r - e, nl)
             ordp[pl.ds(b2 * K, K), :] = no
             lenp[pl.ds(b2 * K, K), :] = nl
+            # Aux pieces: piece 0 keeps the original head; later pieces
+            # chain to their predecessor char (shared 3-way-split
+            # transform, see rle._split_piece_aux).
+            aux_out = _split_piece_aux(
+                (olp[pl.ds(b2 * K, K), :], orp[pl.ds(b2 * K, K), :],
+                 rkp[pl.ds(b2 * K, K), :]),
+                idx_k, row2, amt, w1, w2, so, a, e, has_head)
+            for ap, na in zip((olp, orp, rkp), aux_out):
+                ap[pl.ds(b2 * K, K), :] = na
             rws[pl.ds(l2, 1), :] = rws[pl.ds(l2, 1), :] + amt
             liv[pl.ds(l2, 1), :] = liv[pl.ds(l2, 1), :] - cov
             cumliv[:] = jnp.where(idx_l >= l2, cumliv[:] - cov,
@@ -702,6 +893,7 @@ def make_replayer_rle_mixed(
     block_k: int = 256,
     chunk: int = 1024,
     interpret: bool = False,
+    fast_integrate: bool = True,
 ):
     """Stage a mixed local/remote op stream on the RUN representation and
     build a jitted replayer.
@@ -765,7 +957,7 @@ def make_replayer_rle_mixed(
 
     call = pl.pallas_call(
         partial(_mixed_rle_kernel, K=block_k, NB=NB, NBL=NBLp, CHUNK=chunk,
-                OT=OT),
+                OT=OT, FAST=fast_integrate),
         grid=(s_pad // chunk,),
         in_specs=[smem() for _ in range(9)] + [
             whole((OT, LANES)), whole((OT, LANES)), whole((OT, LANES))],
@@ -801,6 +993,10 @@ def make_replayer_rle_mixed(
             pltpu.VMEM((OT, LANES), jnp.int32),         # ordblk
             pltpu.VMEM((OT, LANES), jnp.int32),         # ol table
             pltpu.VMEM((OT, LANES), jnp.int32),         # or table
+            pltpu.VMEM((capacity, batch), jnp.int32),   # olp (run aux)
+            pltpu.VMEM((capacity, batch), jnp.int32),   # orp
+            pltpu.VMEM((capacity, batch), jnp.int32),   # rkp
+            pltpu.VMEM((capacity, batch), jnp.int32),   # lpp
             pltpu.SMEM((2,), jnp.int32),                # meta
         ],
         compiler_params=pltpu.CompilerParams(
