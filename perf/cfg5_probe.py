@@ -38,8 +38,9 @@ def continue_patches(rng, content, steps, ins_prob=0.45):
     return patches, content
 
 
-def main():
-    n_docs, steps = 2048, 100
+def build_cfg5_stacked(n_docs=2048, steps=100):
+    """The cfg5-shaped stacked stream (shared with perf/cfg5_sweep.py
+    so probe and sweep always tune the SAME workload)."""
     rngs = [random.Random(1000 + d) for d in range(n_docs)]
     contents = [""] * n_docs
     opses = []
@@ -48,7 +49,12 @@ def main():
                                                 steps)
         ops, _ = B.compile_local_patches(patches, lmax=4, dmax=None)
         opses.append(ops)
-    stacked = B.stack_ops(opses)
+    return B.stack_ops(opses)
+
+
+def main():
+    n_docs, steps = 2048, 100
+    stacked = build_cfg5_stacked(n_docs, steps)
 
     dev = jax.devices()[0]
     print(f"device: {dev.platform} {dev.device_kind}", flush=True)

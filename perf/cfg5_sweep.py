@@ -1,0 +1,58 @@
+"""Config-5 lane-tile / capacity sweep (post-recovery tuning).
+
+The r5 probe (`perf/cfg5_probe.py`) showed ~30% run-to-run variance on
+identical kernels and an untuned lane tile.  Sweep T x capacity, two
+compiles each (variance estimate), one cfg5-shaped chunk (100 steps x
+2048 divergent lanes).
+
+    python perf/cfg5_sweep.py
+"""
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import numpy as np
+
+from text_crdt_rust_tpu.ops import rle_lanes as RL
+from perf.cfg5_probe import build_cfg5_stacked
+
+
+def main():
+    n_docs, steps = 2048, 100
+    stacked = build_cfg5_stacked(n_docs, steps)
+
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} {dev.device_kind}", flush=True)
+    best = (None, 1e9)
+    for cap in (1024, 1664):
+        for tile in (256, 512, 1024):
+            for trial in (1, 2):
+                RL._build_call.cache_clear()
+                try:
+                    run = RL.make_replayer_lanes(
+                        stacked, capacity=cap, chunk=128,
+                        lane_tile=tile)
+                    np.asarray(run().err)
+                except Exception as e:
+                    print(f"cap={cap} T={tile}: FAIL "
+                          f"{type(e).__name__}: {str(e)[:120]}",
+                          flush=True)
+                    break
+                t0 = time.perf_counter()
+                for _ in range(5):
+                    res = run()
+                np.asarray(res.err)
+                dt = (time.perf_counter() - t0) / 5
+                print(f"cap={cap} T={tile} trial{trial}: "
+                      f"{dt * 1e3:.1f}ms/chunk "
+                      f"({dt / steps * 1e6:.0f}us/step)", flush=True)
+                if dt < best[1]:
+                    best = ((cap, tile), dt)
+    print(f"best: cap,T={best[0]} {best[1] * 1e3:.1f}ms/chunk",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
