@@ -6,7 +6,10 @@ merge-appended runs, mid-run cursors) replayed through the mixed RLE
 engine with ``fast_integrate`` ON and OFF: final device state must be
 BIT-IDENTICAL and match the oracle string.  CPU interpret mode.
 
-    python perf/fuzz_mixed_fast.py [n_seeds] [seed0]
+    python perf/fuzz_mixed_fast.py [n_seeds] [seed0] [hard]
+
+``hard`` widens the stream shape: 3-6 peers, 5-9 rounds — deeper
+histories, more concurrent sibling groups and split churn per window.
 """
 import random
 import sys
@@ -28,11 +31,11 @@ from text_crdt_rust_tpu.ops import rle_mixed as RM  # noqa: E402
 from text_crdt_rust_tpu.ops import span_arrays as SA  # noqa: E402
 
 
-def gen_stream(seed):
+def gen_stream(seed, hard=False):
     """Random multi-peer txn stream with cross-merges (causally valid,
     round-robin interleaved)."""
     rng = random.Random(seed)
-    n_peers = rng.randint(2, 4)
+    n_peers = rng.randint(3, 6) if hard else rng.randint(2, 4)
     names = rng.sample(
         ["amy", "bob", "cyd", "dee", "eve", "fay", "gus", "hal"], n_peers)
     docs, agents, marks = [], [], []
@@ -43,7 +46,7 @@ def gen_stream(seed):
         marks.append(0)
     applied = [set() for _ in range(n_peers)]
     flat = []
-    for _ in range(rng.randint(3, 7)):
+    for _ in range(rng.randint(5, 9) if hard else rng.randint(3, 7)):
         for i in range(n_peers):
             d, g = docs[i], agents[i]
             for _ in range(rng.randint(1, 4)):
@@ -72,8 +75,8 @@ def gen_stream(seed):
     return flat
 
 
-def run_one(seed):
-    txns = gen_stream(seed)
+def run_one(seed, hard=False):
+    txns = gen_stream(seed, hard)
     table = B.AgentTable()
     for t in txns:
         table.add(t.id.agent)
@@ -105,15 +108,17 @@ def run_one(seed):
 def main():
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 100
     s0 = int(sys.argv[2]) if len(sys.argv) > 2 else 0
+    hard = len(sys.argv) > 3 and sys.argv[3] == "hard"
     t0 = time.time()
     total = 0
     for i in range(n):
-        total += run_one(s0 + i)
+        total += run_one(s0 + i, hard)
         if (i + 1) % 10 == 0:
             print(f"{i + 1}/{n} seeds ok ({total} txns, "
                   f"{time.time() - t0:.0f}s)", flush=True)
-    print(f"PASS: {n} seeds (base {s0}), {total} txns, "
-          f"zero divergences, {time.time() - t0:.0f}s", flush=True)
+    print(f"PASS: {n} seeds (base {s0}{', hard' if hard else ''}), "
+          f"{total} txns, zero divergences, {time.time() - t0:.0f}s",
+          flush=True)
 
 
 if __name__ == "__main__":
