@@ -1,0 +1,188 @@
+"""Checkpoint corruption: truncated, bit-flipped, or version-mismatched
+files must raise the typed ``CheckpointError`` — never crash with a
+zip/json/numpy internals error, never load garbage (ISSUE 1 satellite).
+"""
+import json
+import random
+
+import numpy as np
+import pytest
+
+from text_crdt_rust_tpu.models import ListCRDT
+from text_crdt_rust_tpu.models.sync import merge_into
+from text_crdt_rust_tpu.utils.checkpoint import (
+    FORMAT_VERSION,
+    CheckpointError,
+    _meta_from_array,
+    _meta_to_array,
+    load_doc,
+    load_flat_doc,
+    save_doc,
+    save_flat_doc,
+)
+
+from test_device_flat import oracle_from_patches, random_patches
+
+
+def two_peer_doc(seed=3):
+    rng = random.Random(seed)
+    pa, _ = random_patches(rng, 40)
+    pb, _ = random_patches(rng, 40)
+    a = oracle_from_patches(pa, agent="peer-a")
+    b = oracle_from_patches(pb, agent="peer-b")
+    merge_into(a, b)
+    return a
+
+
+@pytest.fixture
+def ckpt(tmp_path):
+    doc = two_peer_doc()
+    p = str(tmp_path / "doc.npz")
+    save_doc(doc, p)
+    return doc, p
+
+
+class TestOracleCheckpointIntegrity:
+    def test_valid_roundtrip_regression(self, ckpt):
+        doc, p = ckpt
+        back = load_doc(p)
+        back.check()
+        assert back.to_string() == doc.to_string()
+        assert back.doc_spans() == doc.doc_spans()
+
+    def test_truncations_refused(self, ckpt):
+        _, p = ckpt
+        raw = open(p, "rb").read()
+        for frac in (0.0, 0.1, 0.5, 0.9, 0.999):
+            open(p, "wb").write(raw[: int(len(raw) * frac)])
+            with pytest.raises(CheckpointError):
+                load_doc(p)
+
+    def test_flipped_bytes_refused(self, ckpt):
+        _, p = ckpt
+        raw = open(p, "rb").read()
+        rng = random.Random(0)
+        offsets = set(range(64))                      # zip + meta headers
+        offsets |= {rng.randrange(len(raw)) for _ in range(200)}
+        for off in sorted(offsets):
+            buf = bytearray(raw)
+            buf[off] ^= 1 << rng.randrange(8)
+            if bytes(buf) == raw:
+                continue
+            open(p, "wb").write(bytes(buf))
+            try:
+                back = load_doc(p)
+            except CheckpointError:
+                continue
+            # A flip that numpy/zip tolerated (padding etc.) must still
+            # have produced a bit-identical document, or it had to raise.
+            ref = two_peer_doc()
+            assert back.doc_spans() == ref.doc_spans(), (
+                f"byte {off}: corrupted checkpoint loaded garbage")
+
+    def test_wrong_format_version_refused(self, ckpt, tmp_path):
+        _, p = ckpt
+        with np.load(p) as z:
+            arrays = {k: z[k] for k in z.files}
+        meta = _meta_from_array(arrays.pop("meta"))
+        meta["version"] = FORMAT_VERSION + 7
+        p2 = str(tmp_path / "future.npz")
+        np.savez(p2, meta=_meta_to_array(meta), **arrays)
+        with pytest.raises(CheckpointError, match="version"):
+            load_doc(p2)
+
+    def test_tampered_array_refused_by_content_crc(self, ckpt, tmp_path):
+        """Rewrite one array (valid zip, valid meta) -> content CRC must
+        catch it: zip-level CRCs alone would pass a re-zipped tamper."""
+        _, p = ckpt
+        with np.load(p) as z:
+            arrays = {k: z[k] for k in z.files}
+        meta_arr = arrays.pop("meta")
+        tampered = arrays["order"].copy()
+        tampered[0] ^= 1
+        arrays["order"] = tampered
+        p2 = str(tmp_path / "tampered.npz")
+        np.savez(p2, meta=meta_arr, **arrays)
+        with pytest.raises(CheckpointError, match="CRC"):
+            load_doc(p2)
+
+    def test_not_a_zip_refused(self, tmp_path):
+        p = str(tmp_path / "junk.npz")
+        open(p, "wb").write(b"this is not a checkpoint at all")
+        with pytest.raises(CheckpointError):
+            load_doc(p)
+
+    def test_missing_file_refused(self, tmp_path):
+        with pytest.raises(CheckpointError):
+            load_doc(str(tmp_path / "nope.npz"))
+
+    def test_undecodable_meta_refused(self, ckpt, tmp_path):
+        _, p = ckpt
+        with np.load(p) as z:
+            arrays = {k: z[k] for k in z.files}
+        arrays.pop("meta")
+        p2 = str(tmp_path / "badmeta.npz")
+        np.savez(p2, meta=np.frombuffer(b"{not json", dtype=np.uint8),
+                 **arrays)
+        with pytest.raises(CheckpointError, match="meta"):
+            load_doc(p2)
+        p3 = str(tmp_path / "nometa.npz")
+        np.savez(p3, **arrays)
+        with pytest.raises(CheckpointError, match="meta"):
+            load_doc(p3)
+
+
+class TestFlatCheckpointIntegrity:
+    @pytest.fixture
+    def flat_ckpt(self, tmp_path):
+        from text_crdt_rust_tpu.ops import batch as B
+        from text_crdt_rust_tpu.ops import flat as F
+        from text_crdt_rust_tpu.ops import span_arrays as SA
+
+        rng = random.Random(17)
+        patches, content = random_patches(rng, 30)
+        ops, _ = B.compile_local_patches(patches, lmax=4)
+        doc = F.apply_ops(SA.make_flat_doc(256), ops)
+        p = str(tmp_path / "flat.npz")
+        save_flat_doc(doc, p)
+        return content, p
+
+    def test_roundtrip_then_truncation_refused(self, flat_ckpt):
+        from text_crdt_rust_tpu.ops import span_arrays as SA
+
+        content, p = flat_ckpt
+        assert SA.to_string(load_flat_doc(p)) == content
+        raw = open(p, "rb").read()
+        open(p, "wb").write(raw[: len(raw) // 2])
+        with pytest.raises(CheckpointError):
+            load_flat_doc(p)
+
+    def test_kind_confusion_refused(self, flat_ckpt, tmp_path):
+        _, p = flat_ckpt
+        with pytest.raises(CheckpointError, match="kind"):
+            load_doc(p)
+        doc = two_peer_doc()
+        p2 = str(tmp_path / "oracle.npz")
+        save_doc(doc, p2)
+        with pytest.raises(CheckpointError, match="kind"):
+            load_flat_doc(p2)
+
+    def test_flipped_bytes_refused(self, flat_ckpt):
+        from text_crdt_rust_tpu.ops import span_arrays as SA
+
+        content, p = flat_ckpt
+        raw = open(p, "rb").read()
+        rng = random.Random(1)
+        for _ in range(80):
+            off = rng.randrange(len(raw))
+            buf = bytearray(raw)
+            buf[off] ^= 1 << rng.randrange(8)
+            if bytes(buf) == raw:
+                continue
+            open(p, "wb").write(bytes(buf))
+            try:
+                back = load_flat_doc(p)
+            except CheckpointError:
+                continue
+            assert SA.to_string(back) == content, (
+                f"byte {off}: corrupted flat checkpoint loaded garbage")

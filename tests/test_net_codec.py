@@ -1,0 +1,257 @@
+"""Binary wire codec: round-trip fidelity and hard corruption rejection.
+
+The codec contract (ISSUE 1 acceptance): encode→decode round-trips
+arbitrary exported histories bit-identically, and EVERY single-byte
+corruption of a valid frame is rejected with ``CodecError`` — never an
+uncaught exception. Host-only (no JAX involved on this layer).
+"""
+import random
+
+import pytest
+
+from text_crdt_rust_tpu.common import (
+    RemoteDel,
+    RemoteId,
+    RemoteIns,
+    RemoteTxn,
+    validate_remote_txn,
+)
+from text_crdt_rust_tpu.models.oracle import ListCRDT
+from text_crdt_rust_tpu.models.sync import export_txns_since, merge_into
+from text_crdt_rust_tpu.net import codec
+from text_crdt_rust_tpu.net.codec import (
+    CodecError,
+    decode_frame,
+    decode_frames,
+    encode_digest,
+    encode_request,
+    encode_txns,
+)
+from text_crdt_rust_tpu.utils.randedit import random_patches
+
+
+def seeded_doc(seed: int, steps: int = 15, peers: int = 1) -> ListCRDT:
+    """A small seeded document; multi-peer seeds exercise merged
+    multi-agent histories (string table with several names)."""
+    rng = random.Random(seed)
+    docs = []
+    for p in range(peers):
+        doc = ListCRDT()
+        agent = doc.get_or_create_agent_id(f"peer-{seed}-{p}")
+        patches, _ = random_patches(rng, steps)
+        for patch in patches:
+            if patch.del_len:
+                doc.local_delete(agent, patch.pos, patch.del_len)
+            if patch.ins_content:
+                doc.local_insert(agent, patch.pos, patch.ins_content)
+        docs.append(doc)
+    base = docs[0]
+    for other in docs[1:]:
+        merge_into(base, other)
+    return base
+
+
+class TestRoundTrip:
+    def test_200_seeded_docs_bit_identical(self):
+        """Acceptance: ≥200 seeded docs round-trip bit-identically."""
+        for seed in range(200):
+            doc = seeded_doc(seed, steps=12, peers=1 + seed % 3)
+            txns = export_txns_since(doc, 0)
+            frame = encode_txns(txns)
+            kind, back, consumed = decode_frame(frame)
+            assert kind == codec.KIND_TXNS
+            assert consumed == len(frame)
+            assert back == txns, f"seed {seed} round-trip mismatch"
+
+    def test_decoded_history_rebuilds_identical_doc(self):
+        doc = seeded_doc(7, steps=40, peers=2)
+        txns = export_txns_since(doc, 0)
+        _, back, _ = decode_frame(encode_txns(txns))
+        rebuilt = ListCRDT()
+        for t in back:
+            rebuilt.apply_remote_txn(t)
+        assert rebuilt.to_string() == doc.to_string()
+        assert rebuilt.doc_spans() == doc.doc_spans()
+
+    def test_unicode_content(self):
+        txns = [RemoteTxn(
+            RemoteId("ünïcode-agent", 0), [RemoteId("ROOT", 0xFFFFFFFF)],
+            [RemoteIns(RemoteId("ROOT", 0xFFFFFFFF),
+                       RemoteId("ROOT", 0xFFFFFFFF), "héllo 世界 🚀")],
+        )]
+        _, back, _ = decode_frame(encode_txns(txns))
+        assert back == txns
+
+    def test_empty_batch_and_stream_of_frames(self):
+        f0 = encode_txns([])
+        f1 = encode_request({"alice": 5, "bob": 0})
+        f2 = encode_digest({"alice": 9}, 0xDEADBEEF)
+        out = decode_frames(f0 + f1 + f2)
+        assert out[0] == (codec.KIND_TXNS, [])
+        assert out[1] == (codec.KIND_REQUEST, {"alice": 5, "bob": 0})
+        assert out[2] == (codec.KIND_DIGEST, ({"alice": 9}, 0xDEADBEEF))
+
+    def test_delete_ops_round_trip(self):
+        txns = [RemoteTxn(
+            RemoteId("a", 4), [RemoteId("a", 3)],
+            [RemoteDel(RemoteId("b", 10), 7)],
+        )]
+        _, back, _ = decode_frame(encode_txns(txns))
+        assert back == txns
+
+
+class TestCorruptionRejection:
+    """Every single-byte corruption must raise CodecError — nothing else."""
+
+    def _frame(self, seed=3, steps=10, peers=2):
+        doc = seeded_doc(seed, steps=steps, peers=peers)
+        return encode_txns(export_txns_since(doc, 0))
+
+    def test_every_single_byte_value_corruption_rejected(self):
+        """Exhaustive: every byte position × every wrong byte value
+        (a small frame keeps the 255 × len decode sweep fast)."""
+        frame = self._frame(steps=4, peers=1)
+        for i in range(len(frame)):
+            orig = frame[i]
+            for val in range(256):
+                if val == orig:
+                    continue
+                buf = bytearray(frame)
+                buf[i] = val
+                with pytest.raises(CodecError):
+                    decode_frame(bytes(buf))
+
+    def test_bitflips_across_many_frames(self):
+        for seed in range(20):
+            frame = self._frame(seed)
+            rng = random.Random(seed)
+            for _ in range(32):
+                i = rng.randrange(len(frame))
+                buf = bytearray(frame)
+                buf[i] ^= 1 << rng.randrange(8)
+                with pytest.raises(CodecError):
+                    decode_frame(bytes(buf))
+
+    def test_every_truncation_rejected(self):
+        frame = self._frame()
+        for cut in range(len(frame)):
+            with pytest.raises(CodecError):
+                decode_frame(frame[:cut])
+
+    def test_control_frame_corruption_rejected(self):
+        for frame in (encode_request({"alice": 3}),
+                      encode_digest({"alice": 3, "bob": 9}, 123456)):
+            for i in range(len(frame)):
+                buf = bytearray(frame)
+                buf[i] ^= 0x40
+                with pytest.raises(CodecError):
+                    decode_frame(bytes(buf))
+
+
+class TestStructuralValidation:
+    """CRC-valid frames with malformed bodies are still rejected."""
+
+    def test_unknown_kind(self):
+        with pytest.raises(CodecError, match="kind"):
+            decode_frame(codec._frame(bytes([99])))
+
+    def test_unknown_op_tag(self):
+        body = bytearray([codec.KIND_TXNS])
+        codec._write_names(body, ["a"])
+        codec._write_varint(body, 1)      # one txn
+        codec._write_varint(body, 0)      # agent idx
+        codec._write_varint(body, 0)      # seq
+        codec._write_varint(body, 0)      # no parents
+        codec._write_varint(body, 1)      # one op
+        body.append(7)                    # bogus tag
+        with pytest.raises(CodecError, match="tag"):
+            decode_frame(codec._frame(bytes(body)))
+
+    def test_agent_index_out_of_range(self):
+        body = bytearray([codec.KIND_TXNS])
+        codec._write_names(body, ["a"])
+        codec._write_varint(body, 1)
+        codec._write_varint(body, 5)      # agent idx 5, table has 1
+        codec._write_varint(body, 0)
+        with pytest.raises(CodecError, match="agent index"):
+            decode_frame(codec._frame(bytes(body)))
+
+    def test_trailing_garbage_rejected(self):
+        body = bytearray([codec.KIND_TXNS])
+        codec._write_names(body, [])
+        codec._write_varint(body, 0)
+        body += b"\x00\x00"               # junk after the batch
+        with pytest.raises(CodecError, match="trailing"):
+            decode_frame(codec._frame(bytes(body)))
+
+    def test_oversized_varint_rejected(self):
+        body = bytes([codec.KIND_TXNS]) + b"\xff" * 11
+        with pytest.raises(CodecError, match="varint"):
+            decode_frame(codec._frame(body))
+
+    def test_oversized_agent_name_rejected_both_sides(self):
+        """Agent names are capped (4 KiB): an unbounded name would be
+        applied and then crash the digest/gossip path downstream. The
+        ENCODER fails fast (emitting it would poison the re-request
+        cycle: every compliant peer rejects the frame forever), and the
+        DECODER rejects a non-compliant sender's frame."""
+        txns = [RemoteTxn(
+            RemoteId("x" * 70000, 0), [RemoteId("ROOT", 0xFFFFFFFF)],
+            [RemoteIns(RemoteId("ROOT", 0xFFFFFFFF),
+                       RemoteId("ROOT", 0xFFFFFFFF), "hi")],
+        )]
+        with pytest.raises(CodecError, match="cap"):
+            encode_txns(txns)
+        with pytest.raises(CodecError, match="cap"):
+            encode_request({"x" * 70000: 0})
+        # Hand-built frame from a non-compliant sender.
+        body = bytearray([codec.KIND_TXNS])
+        raw = ("y" * 70000).encode("utf-8")
+        codec._write_varint(body, 1)        # one table entry
+        codec._write_varint(body, len(raw))
+        body += raw
+        codec._write_varint(body, 0)        # zero txns
+        with pytest.raises(CodecError, match="cap"):
+            decode_frame(codec._frame(bytes(body)))
+
+    def test_huge_delete_length_rejected(self):
+        """An unchecked 2^60 delete length would poison the receiver's
+        per-agent watermark (seq + len) forever."""
+        body = bytearray([codec.KIND_TXNS])
+        codec._write_names(body, ["a", "b"])
+        codec._write_varint(body, 1)
+        codec._write_varint(body, 0)      # author a
+        codec._write_varint(body, 0)      # seq 0
+        codec._write_varint(body, 0)      # no parents
+        codec._write_varint(body, 1)      # one op
+        body.append(1)                    # RemoteDel
+        codec._write_varint(body, 1)      # target agent b
+        codec._write_varint(body, 0)      # target seq
+        codec._write_varint(body, 1 << 60)
+        with pytest.raises(CodecError, match="u32"):
+            decode_frame(codec._frame(bytes(body)))
+
+    def test_zero_length_txn_rejected(self):
+        body = bytearray([codec.KIND_TXNS])
+        codec._write_names(body, ["a"])
+        codec._write_varint(body, 1)
+        codec._write_varint(body, 0)      # agent
+        codec._write_varint(body, 0)      # seq
+        codec._write_varint(body, 0)      # no parents
+        codec._write_varint(body, 0)      # NO ops -> invalid txn
+        with pytest.raises(CodecError, match="invalid txn"):
+            decode_frame(codec._frame(bytes(body)))
+
+    def test_empty_input_rejected(self):
+        with pytest.raises(CodecError):
+            decode_frame(b"")
+
+    def test_validate_remote_txn_guards(self):
+        with pytest.raises(ValueError):
+            validate_remote_txn(RemoteTxn(RemoteId("ROOT", 0), [], []))
+        with pytest.raises(ValueError):
+            validate_remote_txn(RemoteTxn(RemoteId("a", 0), [], []))
+        with pytest.raises(ValueError):
+            validate_remote_txn(RemoteTxn(
+                RemoteId("a", 0), [],
+                [RemoteDel(RemoteId("b", 0), 0)]))

@@ -1,0 +1,403 @@
+"""Resync session layer: gap re-request with backoff, bounded buffering,
+divergence detection, and graceful device-engine degradation.
+
+Protocol failures here must be *typed and recoverable*: ``CodecError``
+rejections are counted and re-covered, an unrecoverable gap raises
+``CausalGapError``, and device capacity overflow falls back to the host
+oracle — never an assert on the serving path (ISSUE 1 tentpole §3).
+"""
+import random
+
+import pytest
+
+from text_crdt_rust_tpu.common import RemoteId, RemoteIns, RemoteTxn
+from text_crdt_rust_tpu.models.oracle import ListCRDT
+from text_crdt_rust_tpu.models.sync import (
+    agent_watermarks,
+    export_txns_since,
+    state_digest,
+)
+from text_crdt_rust_tpu.net import codec
+from text_crdt_rust_tpu.net.session import (
+    CausalGapError,
+    DeviceMirror,
+    ResyncSession,
+)
+from text_crdt_rust_tpu.ops import span_arrays as SA
+from text_crdt_rust_tpu.parallel.causal import CausalBuffer
+from text_crdt_rust_tpu.utils.metrics import causal_buffer_stats
+
+ROOT = RemoteId("ROOT", 0xFFFFFFFF)
+
+
+def mk_txn(agent: str, seq: int, text: str, parents=None) -> RemoteTxn:
+    return RemoteTxn(
+        RemoteId(agent, seq), list(parents or [ROOT]),
+        [RemoteIns(ROOT, ROOT, text)],
+    )
+
+
+def editing_peer(name: str, steps: int = 12, seed: int = 0):
+    rng = random.Random(seed)
+    doc = ListCRDT()
+    agent = doc.get_or_create_agent_id(name)
+    for _ in range(steps):
+        pos = rng.randrange(len(doc) + 1)
+        doc.local_insert(agent, pos, rng.choice("abcdef") * 2)
+    return doc
+
+
+def clean_sync(src_doc: ListCRDT, dst: ResyncSession) -> None:
+    """Deliver src's full history to dst through the codec, no faults."""
+    txns = export_txns_since(src_doc, 0)
+    for i in range(0, len(txns), 4):
+        dst.receive(codec.encode_txns(txns[i:i + 4]))
+
+
+class TestCausalBufferIntrospection:
+    """Satellite: pending count, watermark gaps, duplicate-drop counter."""
+
+    def test_duplicate_and_gap_counters(self):
+        buf = CausalBuffer()
+        assert buf.add(mk_txn("a", 0, "xx")) != []
+        assert buf.add(mk_txn("a", 0, "xx")) == []   # full duplicate
+        assert buf.duplicates_dropped == 1
+        # Gap: seq 4 with watermark 2 blocks.
+        assert buf.add(mk_txn("a", 4, "yy")) == []
+        assert buf.pending == 1
+        assert buf.high_water == 1
+        stats = causal_buffer_stats(buf)
+        assert stats["pending"] == 1
+        assert stats["duplicates_dropped"] == 1
+        assert stats["watermarks"] == {"a": 2}
+        assert stats["agent_gaps"]["a"]["gap"] == 2
+        assert stats["agent_gaps"]["a"]["blocked"] == 1
+        assert [r.agent for r in buf.missing()] == ["a"]
+
+    def test_bounded_buffer_evicts_farthest_and_rerequests(self):
+        buf = CausalBuffer(max_pending=2)
+        buf.add(mk_txn("a", 0, "xx"))            # released, wm=2
+        buf.add(mk_txn("a", 10, "b1"))           # gap 8
+        buf.add(mk_txn("a", 4, "b2"))            # gap 2
+        assert buf.pending == 2
+        buf.add(mk_txn("a", 30, "b3"))           # gap 28 -> evicted itself
+        assert buf.pending == 2
+        assert buf.evictions == 1
+        assert buf.high_water == 3
+        # The nearest-to-ready txns survived; the gap is still reported
+        # so the session re-requests (eviction costs a retransmit only).
+        held = sorted(t.id.seq for t in buf._pending)
+        assert held == [4, 10]
+        assert buf.missing()[0] == RemoteId("a", 2)
+
+    def test_evicting_sole_pending_txn_keeps_gap_visible(self):
+        buf = CausalBuffer(max_pending=1)
+        buf.add(mk_txn("a", 4, "b1"))            # blocked, sole pending
+        buf.add(mk_txn("b", 9, "b2"))            # evicts (a,4): gap 4 > ?
+        evicted_agent = ({"a", "b"}
+                         - {t.id.agent for t in buf._pending}).pop()
+        # The evicted agent's gap must STILL be reported so the session
+        # re-requests it, even with no pending txn left for that agent.
+        assert any(r.agent == evicted_agent for r in buf.missing())
+        # Redelivery from seq 0 closes it and retires the record.
+        released = buf.add_all(
+            [mk_txn(evicted_agent, s, "xy") for s in range(0, 12, 2)])
+        assert released
+        assert all(r.agent != evicted_agent for r in buf.missing())
+
+    def test_batch_watermark_advance_drains_once(self):
+        buf = CausalBuffer()
+        # Pending txn of agent b parented on a's progress; doc applied
+        # both agents' history out-of-band (sibling session).
+        t = mk_txn("b", 5, "zz", parents=[RemoteId("a", 1)])
+        assert buf.add(t) == []
+        released = buf.advance_watermarks({"a": 2, "b": 5})
+        assert released == [t]
+        assert buf.watermarks()["b"] == 7
+
+
+class TestBackoffAndGapError:
+    def _gapped_session(self, **kw):
+        doc = ListCRDT()
+        s = ResyncSession(doc, **kw)
+        # Deliver a txn with a missing predecessor: seq 2 while wm is 0.
+        s.receive(codec.encode_txns([mk_txn("ghost", 2, "zz")]))
+        assert s.buffer.pending == 1
+        return s
+
+    def test_rerequest_backoff_is_capped_exponential(self):
+        s = self._gapped_session(backoff_base=1, backoff_cap=8,
+                                 retry_limit=32)
+        request_ticks = []
+        for tick in range(1, 40):
+            for frame in s.poll():
+                kind, value, _ = codec.decode_frame(frame)
+                if kind == codec.KIND_REQUEST:
+                    request_ticks.append(tick)
+                    assert value == {"ghost": 0}
+        gaps = [b - a for a, b in zip(request_ticks, request_ticks[1:])]
+        # Delays double 1,2,4,8 then stay capped at 8.
+        assert gaps[:4] == [1, 2, 4, 8]
+        assert all(g == 8 for g in gaps[4:])
+        assert s.counters.get("range_retries") == len(request_ticks)
+
+    def test_gap_outliving_retries_raises_typed_error(self):
+        s = self._gapped_session(retry_limit=3, backoff_cap=1)
+        with pytest.raises(CausalGapError) as ei:
+            for _ in range(20):
+                s.poll()
+        assert ei.value.missing == {"ghost": 0}
+        assert ei.value.attempts == 3
+
+    def test_gap_closed_by_redelivery_clears_schedule(self):
+        s = self._gapped_session(backoff_cap=1)
+        s.poll()
+        s.receive(codec.encode_txns([mk_txn("ghost", 0, "aa")]))
+        assert s.buffer.pending == 0
+        # Both runs are ROOT/ROOT siblings from the same agent: the YATA
+        # scan breaks at the equal-origin-right sibling, so the later-seq
+        # run ("zz") lands first.
+        assert s.doc.to_string() == "zzaa"
+        assert s._requests == {} or s.poll() is not None
+        # No further REQUEST frames once the gap is closed.
+        frames = [codec.decode_frame(f)[0] for f in s.poll()]
+        assert codec.KIND_REQUEST not in frames
+
+    def test_progressing_backfill_resets_attempt_budget(self):
+        """A long lossy backfill keeps a gap open for many polls, but the
+        watermark advances between asks — that must NOT accumulate toward
+        CausalGapError (only a gap that never moves is unrecoverable)."""
+        s = ResyncSession(ListCRDT(), retry_limit=3, backoff_cap=1)
+        # A far-future txn keeps the gap visible for the whole backfill.
+        s.receive(codec.encode_txns(
+            [mk_txn("ghost", 1000, "zz",
+                    parents=[RemoteId("ghost", 999)])]))
+        for step in range(12):
+            # Drip txn seq 2*step (len 2) per poll: the gap's from_seq
+            # advances every ask, so the attempt budget keeps resetting.
+            s.receive(codec.encode_txns(
+                [mk_txn("ghost", 2 * step, "ab",
+                        parents=[ROOT] if step == 0
+                        else [RemoteId("ghost", 2 * step - 1)])]))
+            s.poll()   # 12 asks total with retry_limit=3: never raises
+        assert s.counters.get("range_retries") == 12
+        assert s.buffer.watermarks()["ghost"] == 24
+
+    def test_unknown_reference_rejected_typed_not_crash(self):
+        """A well-formed (valid-CRC) txn whose delete targets an agent we
+        have never heard of must be rejected and counted — the causal
+        buffer only checks parents, and the oracle would hard-assert."""
+        from text_crdt_rust_tpu.common import RemoteDel
+        s = ResyncSession(ListCRDT())
+        evil = RemoteTxn(RemoteId("mallory", 0), [ROOT],
+                         [RemoteDel(RemoteId("nobody", 50), 1)])
+        assert s.receive(codec.encode_txns([evil])) == []
+        assert s.counters.get("txns_rejected") == 1
+        assert s.protocol_error
+        assert s.doc.n == 0
+        # The session keeps working for honest peers afterwards.
+        s.receive(codec.encode_txns([mk_txn("honest", 0, "ok")]))
+        assert s.doc.to_string() == "ok"
+
+    def test_self_referencing_txn_rejected_not_crash(self):
+        """A txn deleting its OWN op's seq (or origin-chaining forward)
+        names no document item — must reject typed, not assert."""
+        from text_crdt_rust_tpu.common import RemoteDel
+        s = ResyncSession(ListCRDT())
+        # Delete of the txn's own (not-an-insert) seq 0.
+        evil1 = RemoteTxn(RemoteId("e1", 0), [ROOT],
+                          [RemoteDel(RemoteId("e1", 0), 1)])
+        # Insert whose origin points FORWARD into the same txn.
+        evil2 = RemoteTxn(RemoteId("e2", 0), [ROOT],
+                          [RemoteIns(RemoteId("e2", 1), ROOT, "xx")])
+        # Delete of own delete-op seqs (ins at 0..2, del op ids 2..3,
+        # targeting seq 2 = the delete op itself, not an item).
+        evil3 = RemoteTxn(RemoteId("e3", 0), [ROOT],
+                          [RemoteIns(ROOT, ROOT, "ab"),
+                           RemoteDel(RemoteId("e3", 2), 1)])
+        for evil in (evil1, evil2, evil3):
+            assert s.receive(codec.encode_txns([evil])) == []
+        assert s.counters.get("txns_rejected") == 3
+        # Legitimate intra-txn chains still apply: insert then delete of
+        # the chars the same txn inserted.
+        ok = RemoteTxn(RemoteId("good", 0), [ROOT],
+                       [RemoteIns(ROOT, ROOT, "abc"),
+                        RemoteDel(RemoteId("good", 1), 1)])
+        s.receive(codec.encode_txns([ok]))
+        assert s.doc.to_string() == "ac"
+
+    def test_rejected_txn_rolls_back_watermark_for_honest_redelivery(self):
+        """Rejecting a released txn must NOT burn its (agent, seq): the
+        buffer watermark rolls back so an honest redelivery applies and
+        the gap stays visible to the re-request cycle meanwhile."""
+        from text_crdt_rust_tpu.common import RemoteDel
+        s = ResyncSession(ListCRDT())
+        evil = RemoteTxn(RemoteId("m", 0), [ROOT],
+                         [RemoteDel(RemoteId("nobody", 5), 1)])
+        s.receive(codec.encode_txns([evil]))
+        assert s.counters.get("txns_rejected") == 1
+        assert s.buffer.watermarks().get("m", 0) == 0   # rolled back
+        # An honest peer's digest advertising m@2 now yields a want.
+        s.receive(codec.encode_digest({"m": 2}, 0))
+        assert s._wanted() == {"m": 0}
+        # Honest redelivery of the REAL m@0 applies (not deduped).
+        s.receive(codec.encode_txns([mk_txn("m", 0, "ok")]))
+        assert s.doc.to_string() == "ok"
+        assert agent_watermarks(s.doc)["m"] == 2
+
+    def test_dependent_of_rejected_txn_also_rejected_not_crash(self):
+        """A txn parented on a rejected txn must be rejected too (its
+        parent maps to no order), not crash the oracle."""
+        from text_crdt_rust_tpu.common import RemoteDel
+        s = ResyncSession(ListCRDT())
+        evil = RemoteTxn(RemoteId("m", 0), [ROOT],
+                         [RemoteDel(RemoteId("nobody", 5), 2)])
+        child = mk_txn("c", 0, "hi", parents=[RemoteId("m", 1)])
+        assert s.receive(codec.encode_txns([evil, child])) == []
+        assert s.counters.get("txns_rejected") == 2
+        assert s.doc.n == 0
+        assert s.buffer.watermarks().get("c", 0) == 0
+
+    def test_successor_of_rejected_txn_rejected_by_seq_gate(self):
+        """After a same-agent rejection rolls the watermark back, a
+        successor in the SAME released batch that references nothing of
+        the rejected txn must still be rejected (seq out of order against
+        the doc), not crash the oracle's in-order assert."""
+        from text_crdt_rust_tpu.common import RemoteDel
+        s = ResyncSession(ListCRDT())
+        bad = RemoteTxn(RemoteId("x", 0), [ROOT],
+                        [RemoteDel(RemoteId("nobody", 5), 1)])
+        succ = RemoteTxn(RemoteId("x", 1), [ROOT],
+                         [RemoteIns(ROOT, ROOT, "hi")])
+        assert s.receive(codec.encode_txns([bad, succ])) == []
+        assert s.counters.get("txns_rejected") == 2
+        assert s.doc.n == 0
+        # Honest full redelivery from seq 0 recovers both slots.
+        s.receive(codec.encode_txns([mk_txn("x", 0, "a"),
+                                     mk_txn("x", 1, "b",
+                                            parents=[RemoteId("x", 0)])]))
+        assert agent_watermarks(s.doc)["x"] == 2
+
+    def test_origin_naming_delete_op_seq_rejected(self):
+        """A delete op's consumed seq maps to an order but names no body
+        item — an origin pointing at it must be rejected, not crash
+        raw_index_of_order."""
+        s = ResyncSession(ListCRDT())
+        # Build known history: y inserts "ab" (seqs 0-1), deletes 1 char
+        # (delete op consumes seq 2) -> watermark 3.
+        y = s.doc.get_or_create_agent_id("y")
+        s.doc.local_insert(y, 0, "ab")
+        s.doc.local_delete(y, 0, 1)
+        evil = RemoteTxn(RemoteId("m", 0), [ROOT],
+                         [RemoteIns(RemoteId("y", 2), ROOT, "zz")])
+        assert s.receive(codec.encode_txns([evil])) == []
+        assert s.counters.get("txns_rejected") == 1
+        # Origins naming REAL items (seq 1, even tombstoned seq 0) apply.
+        ok = RemoteTxn(RemoteId("m", 0), [ROOT],
+                       [RemoteIns(RemoteId("y", 0), ROOT, "zz")])
+        s.receive(codec.encode_txns([ok]))
+        assert "zz" in s.doc.to_string()
+
+    def test_parentless_txn_rejected_at_codec(self):
+        """A parentless txn would plant a second root in the time DAG;
+        the codec refuses to decode (and encode) it."""
+        from text_crdt_rust_tpu.net.codec import CodecError
+        body = bytearray([codec.KIND_TXNS])
+        codec._write_names(body, ["m"])
+        codec._write_varint(body, 1)
+        codec._write_varint(body, 0)   # author m
+        codec._write_varint(body, 0)   # seq 0
+        codec._write_varint(body, 0)   # NO parents
+        codec._write_varint(body, 1)   # one op
+        body.append(0)                 # RemoteIns
+        codec._write_varint(body, 0); codec._write_varint(body, 0)
+        codec._write_varint(body, 0); codec._write_varint(body, 0)
+        codec._write_str(body, "hi")
+        with pytest.raises(CodecError, match="parents"):
+            codec.decode_frame(codec._frame(bytes(body)))
+
+    def test_corrupt_frame_counted_not_raised(self):
+        s = self._gapped_session()
+        assert s.receive(b"\x00garbage") == []
+        assert s.receive(b"") == []
+        assert s.counters.get("frames_rejected") == 2
+
+
+class TestDigestsAndDivergence:
+    def test_digest_reveals_fully_dropped_agent(self):
+        """Every TXNS frame from a peer lost: the causal buffer sees no
+        gap (nothing pending), only the digest exchange reveals it."""
+        peer = editing_peer("alice", steps=6)
+        s = ResyncSession(ListCRDT(), backoff_cap=1)
+        assert s.buffer.missing() == []
+        s.receive(codec.encode_digest(
+            agent_watermarks(peer), state_digest(peer)))
+        frames = s.poll()
+        reqs = [v for f in frames
+                for k, v, _ in [codec.decode_frame(f)]
+                if k == codec.KIND_REQUEST]
+        assert reqs and reqs[0] == {"alice": 0}
+
+    def test_request_served_and_convergence(self):
+        peer = editing_peer("alice", steps=6)
+        serving = ResyncSession(peer)
+        s = ResyncSession(ListCRDT())
+        responses = serving.receive(codec.encode_request({"alice": 0}))
+        assert responses
+        for r in responses:
+            s.receive(r)
+        assert s.doc.to_string() == peer.to_string()
+        assert serving.counters.get("requests_served") == 1
+
+    def test_divergence_detected_on_equal_watermarks(self):
+        peer = editing_peer("alice", steps=6)
+        s = ResyncSession(ListCRDT())
+        clean_sync(peer, s)
+        assert state_digest(s.doc) == state_digest(peer)
+        # Corrupt the replica out-of-band: flip a tombstone. Same op set
+        # (watermarks equal), different state -> divergence, not silence.
+        s.doc.deleted[0] = not s.doc.deleted[0]
+        s.receive(codec.encode_digest(
+            agent_watermarks(peer), state_digest(peer)))
+        assert s.divergence_detected
+        assert s.counters.get("divergence_detected") == 1
+
+    def test_no_false_divergence_while_behind(self):
+        peer = editing_peer("alice", steps=6)
+        s = ResyncSession(ListCRDT())
+        s.receive(codec.encode_digest(
+            agent_watermarks(peer), state_digest(peer)))
+        assert not s.divergence_detected
+
+
+class TestDeviceMirror:
+    def test_mirror_tracks_oracle_bit_identically(self):
+        peer = editing_peer("alice", steps=10)
+        mirror = DeviceMirror(capacity=256, agents=("alice",))
+        s = ResyncSession(ListCRDT(), mirror=mirror)
+        clean_sync(peer, s)
+        assert not mirror.degraded
+        assert SA.doc_spans(mirror.doc) == s.doc.doc_spans()
+        assert SA.to_string(mirror.doc) == s.doc.to_string()
+        assert s.device_doc is mirror.doc
+
+    def test_capacity_overflow_degrades_to_oracle(self):
+        peer = editing_peer("alice", steps=10)
+        mirror = DeviceMirror(capacity=8, agents=("alice",))
+        s = ResyncSession(ListCRDT(), mirror=mirror)
+        clean_sync(peer, s)                    # no exception anywhere
+        assert mirror.degraded
+        assert "overflow" in mirror.degrade_reason
+        assert s.counters.get("device_degraded") == 1
+        # Oracle stays the source of truth and keeps serving.
+        assert s.doc.to_string() == peer.to_string()
+        assert s.device_doc is s.doc
+
+    def test_unregistered_agent_degrades_not_asserts(self):
+        peer = editing_peer("mallory", steps=4)
+        mirror = DeviceMirror(capacity=256, agents=("alice",))
+        s = ResyncSession(ListCRDT(), mirror=mirror)
+        clean_sync(peer, s)
+        assert mirror.degraded
+        assert "mallory" in mirror.degrade_reason
+        assert s.doc.to_string() == peer.to_string()

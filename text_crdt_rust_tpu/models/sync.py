@@ -14,9 +14,12 @@ numeric agent ids and orders are peer-local (`README.md:33-35`,
 """
 from __future__ import annotations
 
-from typing import List, Set
+import struct
+import zlib
+from typing import Dict, List, Set
 
 from ..common import (
+    CLIENT_INVALID,
     ROOT_ORDER,
     RemoteDel,
     RemoteId,
@@ -39,6 +42,10 @@ def export_txns_since(doc: ListCRDT, start_order: int = 0) -> List[RemoteTxn]:
     """
     out: List[RemoteTxn] = []
     end_order = doc.get_next_order()
+    if start_order >= end_order:
+        # Idle hot path: a session polls this every tick — don't build
+        # the O(n) index when there is nothing to export.
+        return out
     # One pass over the body: order -> raw index (avoids a per-char scan).
     idx_of = {int(doc.order[i]): i for i in range(doc.n)}
     o = start_order
@@ -142,3 +149,64 @@ def merge_into(dst: ListCRDT, src: ListCRDT) -> int:
 def remote_frontier(doc: ListCRDT) -> Set[RemoteId]:
     """Frontier as peer-portable ids (orders are peer-local)."""
     return {doc.order_to_remote_id(o) for o in doc.frontier}
+
+
+def agent_watermarks(doc: ListCRDT) -> Dict[str, int]:
+    """Per-agent next expected seq — the peer-portable progress vector a
+    DIGEST frame advertises (`net/session.py`). Orders are peer-local;
+    (agent name, seq) watermarks are the only comparable progress."""
+    return {cd.name: cd.get_next_seq() for cd in doc.client_data}
+
+
+def state_digest(doc: ListCRDT) -> int:
+    """Order-independent 32-bit digest of the *converged* state.
+
+    Hashes the document body in document order as peer-portable
+    (agent name, seq, deleted) triples plus the sorted remote frontier —
+    never local orders, which differ across peers that interleaved the
+    same history differently. Two peers that have applied the same op set
+    converge to the same YATA document order (PAPER.md §1), so equal
+    history ⇒ equal digest; equal watermarks with UNEQUAL digests is the
+    divergence signal the resync session trips on.
+    """
+    h = 0
+    # u32 length prefix: agent names are unbounded strings (the codec
+    # caps them at 4 KiB, but the digest must never be the crash site).
+    for i in range(doc.n):
+        agent, seq = doc.loc_of_order(int(doc.order[i]))
+        name = doc.get_agent_name(agent).encode("utf-8")
+        h = zlib.crc32(struct.pack("<I", len(name)) + name, h)
+        h = zlib.crc32(
+            struct.pack("<IB", seq, 1 if doc.deleted[i] else 0), h)
+    frontier = sorted(((r.agent, r.seq) for r in remote_frontier(doc)))
+    for name_s, seq in frontier:
+        name = name_s.encode("utf-8")
+        h = zlib.crc32(struct.pack("<I", len(name)) + name, h)
+        h = zlib.crc32(struct.pack("<I", seq), h)
+    return h & 0xFFFF_FFFF
+
+
+def export_txns_for_wants(doc: ListCRDT,
+                          wants: Dict[str, int]) -> List[RemoteTxn]:
+    """Serve a REQUEST frame: history covering every requested
+    (agent, from_seq..) range this doc knows about.
+
+    Exports since the *minimum* local order covering any requested id —
+    possibly a superset of the ask (linear history interleaves agents),
+    which is safe: the receiver's ``CausalBuffer`` trims known prefixes
+    and drops duplicates idempotently. Unknown agents and already-covered
+    watermarks are skipped; returns ``[]`` when nothing is owed.
+    """
+    start = None
+    for name, from_seq in wants.items():
+        aid = doc.get_agent_id(name)
+        if aid is None or aid == CLIENT_INVALID:
+            continue
+        cd = doc.client_data[aid]
+        if from_seq >= cd.get_next_seq():
+            continue
+        o = cd.seq_to_order(from_seq)
+        start = o if start is None else min(start, o)
+    if start is None:
+        return []
+    return export_txns_since(doc, start)

@@ -1,0 +1,49 @@
+"""Fault-tolerant replication: wire codec, fault injection, resync sessions.
+
+The reference defines the peer-portable structs but leaves the wire
+unfinished ("wire encoding is out of scope", SURVEY §2 L4) and punts on
+out-of-order delivery (`doc.rs:246-247` TODO). This package finishes the
+peer boundary for the production story (ROADMAP north star): history
+crosses the wire as *bytes*, and any byte stream a peer accepts either
+converges bit-identically or is rejected with a precise, typed error —
+never a crash, never a silent divergence.
+
+- ``codec``   — length-prefixed binary frames for ``RemoteTxn`` batches
+  (varint framing, agent-name string table, per-frame CRC32C, format
+  version byte) plus the session control frames (REQUEST / DIGEST).
+- ``faults``  — deterministic seeded fault injection (drop, duplicate,
+  reorder, truncate, bit-flip) for fuzzing the whole stack.
+- ``session`` — anti-entropy resync: per-agent watermarks + state
+  digests detect gaps and divergence, missing ranges are re-requested
+  with capped exponential backoff, the causal buffer is bounded, and
+  device-engine overflow degrades to the host oracle instead of
+  asserting.
+"""
+from .codec import (
+    CodecError,
+    FRAME_VERSION,
+    crc32c,
+    decode_frame,
+    decode_frames,
+    encode_digest,
+    encode_request,
+    encode_txns,
+)
+from .faults import FaultSpec, FaultyChannel
+from .session import CausalGapError, DeviceMirror, ResyncSession
+
+__all__ = [
+    "CodecError",
+    "CausalGapError",
+    "DeviceMirror",
+    "FaultSpec",
+    "FaultyChannel",
+    "FRAME_VERSION",
+    "ResyncSession",
+    "crc32c",
+    "decode_frame",
+    "decode_frames",
+    "encode_digest",
+    "encode_request",
+    "encode_txns",
+]

@@ -115,6 +115,9 @@ class AgentTable:
             return CLIENT_INVALID
         return self._ids[name]
 
+    def __contains__(self, name: str) -> bool:
+        return name in self._ids
+
     def rank_of_agent(self) -> np.ndarray:
         """rank_of_agent[dense agent id] -> name rank (u32)."""
         order = sorted(range(len(self.names)), key=lambda i: self.names[i])

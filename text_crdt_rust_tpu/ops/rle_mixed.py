@@ -302,6 +302,18 @@ def _mixed_rle_kernel(
             # down; the moved-out top half (new physical block nb)
             # lands at slot l + 1.  (Unallocated blocks' rows hold
             # 0, never > l, so the shift cannot touch them.)
+            #
+            # LOAD-BEARING: unlike olp/orp/rkp above, lpp rows of STALE
+            # slots (>= rws, the moved-out top half of block b and the
+            # unused tail of nb) are deliberately NOT zeroed. lpp is
+            # keyed by PHYSICAL row, whole-block: when a later insert
+            # validates one of those rows (rws grows back into them),
+            # the row must already hold its block's logical slot — the
+            # fast-integrate window keys (`integrate_fast`: key =
+            # lpp * K + row) read lpp for every valid row without a
+            # per-row freshness check. Zeroing stale rows here would
+            # make a later-validated row in block b/nb inherit slot 0
+            # and silently corrupt the scan-window bounds.
             lpp[:] = jnp.where(lpp[:] > l, lpp[:] + 1, lpp[:])
             lpp[:] = jnp.where(idx_cap // K == nb, l + 1, lpp[:])
 
@@ -468,8 +480,11 @@ def _mixed_rle_kernel(
         right = jnp.where(succ == 0, root_i,
                           (jnp.abs(succ) - 1).astype(jnp.int32))
 
+        # Split-head order uses jnp.abs like the remote path (`do_remote
+        # _insert`): o_r is signed (tombstone runs are negative), and the
+        # split head's order must be the magnitude regardless of liveness.
         aux_splice(b, i_r, jnp.where(p == 0, 0, i_r + 1), amt, _mrg,
-                   is_split, (o_r - 1) + off - 1, left, right,
+                   is_split, (jnp.abs(o_r) - 1) + off - 1, left, right,
                    tab_read(rkl_in, st))
         ordp[pl.ds(b * K, K), :] = no
         lenp[pl.ds(b * K, K), :] = nl

@@ -16,7 +16,7 @@ Readiness (`doc.rs:242-269` preconditions):
 """
 from __future__ import annotations
 
-from typing import Dict, Iterable, List
+from typing import Dict, Iterable, List, Optional
 
 from ..common import RemoteId, RemoteTxn, split_txn_suffix, txn_len
 
@@ -28,12 +28,36 @@ class CausalBuffer:
     including earlier-buffered ones), in a valid causal order. Duplicate
     and already-known txns are dropped, mirroring the idempotent re-sync
     behavior peers need (`README.md:33-35` peer model).
+
+    ``max_pending`` bounds the buffer: offering a txn to a full buffer
+    evicts the pending txn farthest from readiness (largest seq gap to
+    its author's watermark — the one that needs the most missing history
+    before it can release) instead of growing without bound. Evictions
+    are counted, the watermark is untouched, and the evicted range is
+    remembered (until the watermark covers it) so ``missing()`` still
+    names the gap even when the evicted txn was the agent's only pending
+    entry — the session layer re-requests the range and the peer
+    re-delivers; eviction trades memory for a retransmit, never
+    correctness (`net/session.py`).
+
+    Introspection for that layer (surfaced via
+    ``utils.metrics.causal_buffer_stats``): ``pending``, ``high_water``,
+    ``duplicates_dropped``, ``evictions``, ``watermarks()``,
+    ``gap_stats()``.
     """
 
-    def __init__(self) -> None:
+    def __init__(self, max_pending: Optional[int] = None) -> None:
+        assert max_pending is None or max_pending >= 1
         # Agent name -> next expected seq (the released watermark).
         self._next_seq: Dict[str, int] = {}
         self._pending: List[RemoteTxn] = []
+        self.max_pending = max_pending
+        self.high_water = 0        # max simultaneous pending ever seen
+        self.duplicates_dropped = 0
+        self.evictions = 0
+        # Agent -> end seq of the farthest evicted txn: keeps the gap
+        # visible to missing() until redelivery covers it.
+        self._evicted_ends: Dict[str, int] = {}
 
     def _watermark(self, agent: str) -> int:
         return self._next_seq.get(agent, 0)
@@ -64,6 +88,7 @@ class CausalBuffer:
         """Offer one txn; return every txn that is now ready, causal order."""
         trimmed = self._trim(txn)
         if trimmed is None:
+            self.duplicates_dropped += 1
             return []
         # Re-delivery of a still-blocked txn (peers re-sync while a parent
         # is missing) must not grow the buffer: one entry per (agent, seq),
@@ -73,9 +98,31 @@ class CausalBuffer:
                 if txn_len(trimmed) > txn_len(held):
                     self._pending[i] = trimmed
                     return self._drain()
+                self.duplicates_dropped += 1
                 return []
         self._pending.append(trimmed)
-        return self._drain()
+        self.high_water = max(self.high_water, len(self._pending))
+        released = self._drain()
+        if (self.max_pending is not None
+                and len(self._pending) > self.max_pending):
+            self._evict()
+        return released
+
+    def _evict(self) -> None:
+        """Drop the pending txn farthest from readiness (largest seq gap
+        to its author's watermark). Ties go to the later arrival, so the
+        txn most likely to unblock soonest survives."""
+        worst_i, worst_gap = 0, -1
+        for i, held in enumerate(self._pending):
+            gap = held.id.seq - self._watermark(held.id.agent)
+            if gap >= worst_gap:
+                worst_i, worst_gap = i, gap
+        evicted = self._pending.pop(worst_i)
+        agent = evicted.id.agent
+        end = evicted.id.seq + txn_len(evicted)
+        self._evicted_ends[agent] = max(self._evicted_ends.get(agent, 0),
+                                        end)
+        self.evictions += 1
 
     def add_all(self, txns: Iterable[RemoteTxn]) -> List[RemoteTxn]:
         out: List[RemoteTxn] = []
@@ -111,6 +158,59 @@ class CausalBuffer:
         """Buffered txns still waiting on causal dependencies."""
         return len(self._pending)
 
+    def advance_watermark(self, agent: str, seq: int) -> List[RemoteTxn]:
+        """Record out-of-band progress for ``agent`` (e.g. the session's
+        own local edits, which never flow through the buffer) so echoed
+        re-deliveries trim as duplicates and pending txns parented on that
+        progress can release. Returns any txns that became ready."""
+        return self.advance_watermarks({agent: seq})
+
+    def advance_watermarks(self, marks: Dict[str, int]) -> List[RemoteTxn]:
+        """Batch form of ``advance_watermark``: raise EVERY watermark
+        first, then drain once. Draining per-agent would be wrong when
+        several agents progressed out-of-band (e.g. sessions sharing one
+        document, `net/session.py` N-peer mesh): unblocking agent A's
+        dependents against agent B's still-stale watermark would release
+        a txn the document already applied."""
+        changed = False
+        for agent, seq in marks.items():
+            if seq > self._watermark(agent):
+                self._next_seq[agent] = seq
+                changed = True
+        return self._drain() if changed else []
+
+    def rollback_watermark(self, agent: str, seq: int) -> None:
+        """Undo a release that the caller refused to apply (e.g. the
+        session's reference validation rejected the txn): lower the
+        watermark back to ``seq`` so an honest redelivery of that
+        (agent, seq) is accepted instead of trimmed as a duplicate, and
+        the gap stays visible to the digest/re-request cycle."""
+        if seq < self._watermark(agent):
+            self._next_seq[agent] = seq
+
+    def watermarks(self) -> Dict[str, int]:
+        """Per-agent released watermark (next expected seq), a copy."""
+        return dict(self._next_seq)
+
+    def gap_stats(self) -> Dict[str, Dict[str, int]]:
+        """Per-agent watermark gaps for agents with blocked pending txns:
+        ``{agent: {next_seq, first_pending, gap, blocked}}`` where ``gap``
+        is how many seqs are missing before the earliest pending txn from
+        that agent could release."""
+        out: Dict[str, Dict[str, int]] = {}
+        for txn in self._pending:
+            agent = txn.id.agent
+            wm = self._watermark(agent)
+            slot = out.setdefault(agent, {
+                "next_seq": wm, "first_pending": txn.id.seq,
+                "gap": txn.id.seq - wm, "blocked": 0,
+            })
+            slot["blocked"] += 1
+            if txn.id.seq < slot["first_pending"]:
+                slot["first_pending"] = txn.id.seq
+                slot["gap"] = txn.id.seq - wm
+        return out
+
     def missing(self) -> List[RemoteId]:
         """The frontier of unmet dependencies — the first unreceived
         (agent, seq) per blocking agent, i.e. what to request from peers
@@ -130,4 +230,11 @@ class CausalBuffer:
             for p in txn.parents:
                 if not self._known(p):
                     want(p.agent)
+        # Evicted ranges: the txn is gone but the gap is not — keep
+        # naming it until the watermark covers the evicted end.
+        for agent in list(self._evicted_ends):
+            if self._watermark(agent) >= self._evicted_ends[agent]:
+                del self._evicted_ends[agent]
+            else:
+                want(agent)
         return out

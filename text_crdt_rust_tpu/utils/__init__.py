@@ -1,6 +1,8 @@
-from .checkpoint import load_doc, load_flat_doc, save_doc, save_flat_doc
-from .metrics import (Throughput, doc_stats, memory_stats,
-                      print_stats, run_stats)
+from .checkpoint import (CheckpointError, load_doc, load_flat_doc,
+                         save_doc, save_flat_doc)
+from .integrity import crc32c
+from .metrics import (Counters, Throughput, causal_buffer_stats, doc_stats,
+                      memory_stats, print_stats, run_stats)
 from .rle import (
     KCRDTSpan,
     KDeleteEntry,
@@ -25,11 +27,15 @@ __all__ = [
     "TestTxn",
     "load_testing_data",
     "trace_path",
+    "CheckpointError",
     "load_doc",
     "load_flat_doc",
     "save_doc",
     "save_flat_doc",
+    "crc32c",
+    "Counters",
     "Throughput",
+    "causal_buffer_stats",
     "doc_stats",
     "memory_stats",
     "run_stats",

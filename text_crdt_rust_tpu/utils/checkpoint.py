@@ -17,11 +17,19 @@ cache of their materialization). This module makes that concrete:
 ``save_flat_doc``/``load_flat_doc`` checkpoint a device ``FlatDoc``
 directly (download once, upload on load) for the streaming-apply path
 (`BASELINE.json` config 5's periodic host↔TPU resync).
+
+Integrity (`net/` fault model applied to disk): every checkpoint carries a
+CRC32 over its array contents plus a format version, and loads REFUSE
+corrupted, truncated, or version-mismatched files with a typed
+``CheckpointError`` — a resume must restore bit-identical state or fail
+precisely, never load garbage into a serving replica.
 """
 from __future__ import annotations
 
 import json
-from typing import List
+import zipfile
+import zlib
+from typing import Dict, List
 
 import numpy as np
 
@@ -35,7 +43,14 @@ from .rle import (
     TxnSpan,
 )
 
-FORMAT_VERSION = 1
+# v2: adds the content CRC32 (zlib) to the meta header (v1 files predate
+# integrity checking and are refused — re-save from a live document).
+FORMAT_VERSION = 2
+
+
+class CheckpointError(Exception):
+    """A checkpoint failed to load: corrupted, truncated, or wrong
+    format version. The file is refused whole — no partial state."""
 
 
 def _meta_to_array(meta: dict) -> np.ndarray:
@@ -44,6 +59,64 @@ def _meta_to_array(meta: dict) -> np.ndarray:
 
 def _meta_from_array(arr: np.ndarray) -> dict:
     return json.loads(arr.tobytes().decode("utf-8"))
+
+
+def _content_crc(arrays: Dict[str, np.ndarray]) -> int:
+    """CRC32 over every array's raw bytes, key-sorted (stable across
+    save/load regardless of npz member order). ``zlib.crc32`` (C speed)
+    rather than the wire codec's pure-Python CRC32C: checkpoints are
+    MB-to-GB arrays where the table loop would cost ~0.25 s/MiB on
+    every save AND load; the integrity guarantee is the same."""
+    crc = 0
+    for k in sorted(arrays):
+        a = np.ascontiguousarray(arrays[k])
+        crc = zlib.crc32(a.tobytes(), crc)
+    return crc & 0xFFFF_FFFF
+
+
+def _save_npz(path: str, meta: dict, arrays: Dict[str, np.ndarray]) -> None:
+    meta = dict(meta)
+    meta["crc"] = _content_crc(arrays)
+    np.savez(path, meta=_meta_to_array(meta), **arrays)
+
+
+def _load_npz(path: str, expect_kind: str):
+    """Open + fully validate a checkpoint; returns (meta, {key: array}).
+
+    Raises ``CheckpointError`` on anything short of a bit-perfect file:
+    unreadable/truncated zip, missing members, undecodable meta, version
+    or kind mismatch, or content CRC mismatch.
+    """
+    try:
+        with np.load(path) as z:
+            arrays = {k: z[k] for k in z.files}
+    except (OSError, EOFError, ValueError, KeyError,
+            zipfile.BadZipFile) as e:
+        raise CheckpointError(f"unreadable checkpoint {path!r}: {e}") from e
+    if "meta" not in arrays:
+        raise CheckpointError(f"checkpoint {path!r} has no meta header")
+    try:
+        meta = _meta_from_array(arrays.pop("meta"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise CheckpointError(
+            f"checkpoint {path!r}: undecodable meta header: {e}") from e
+    version = meta.get("version")
+    if version != FORMAT_VERSION:
+        raise CheckpointError(
+            f"checkpoint {path!r}: format version {version!r}, "
+            f"this build reads {FORMAT_VERSION}")
+    if meta.get("kind", "oracle") != expect_kind:
+        raise CheckpointError(
+            f"checkpoint {path!r}: kind {meta.get('kind', 'oracle')!r}, "
+            f"expected {expect_kind!r}")
+    stored = meta.get("crc")
+    computed = _content_crc(arrays)
+    if stored != computed:
+        raise CheckpointError(
+            f"checkpoint {path!r}: content CRC mismatch "
+            f"(stored {stored!r}, computed {computed:#010x}) — "
+            f"file corrupted, refusing to load")
+    return meta, arrays
 
 
 def save_doc(doc, path: str) -> None:
@@ -63,12 +136,11 @@ def save_doc(doc, path: str) -> None:
     ]
     meta = {
         "version": FORMAT_VERSION,
+        "kind": "oracle",
         "agents": [cd.name for cd in doc.client_data],
         "n": n,
     }
-    np.savez(
-        path,
-        meta=_meta_to_array(meta),
+    arrays = dict(
         order=doc.order[:n],
         origin_left=doc.origin_left[:n],
         origin_right=doc.origin_right[:n],
@@ -88,17 +160,31 @@ def save_doc(doc, path: str) -> None:
                         dtype=np.int64).reshape(-1, 3),
         txn_parents=np.asarray(parents, dtype=np.int64).reshape(-1, 2),
     )
+    _save_npz(path, meta, arrays)
 
 
 def load_doc(path: str):
-    """Rebuild an oracle ``ListCRDT`` from a ``save_doc`` checkpoint."""
-    from ..models.oracle import ClientData, ListCRDT
+    """Rebuild an oracle ``ListCRDT`` from a ``save_doc`` checkpoint.
 
-    z = np.load(path)
-    meta = _meta_from_array(z["meta"])
-    assert meta["version"] == FORMAT_VERSION, (
-        f"unknown checkpoint version {meta['version']}")
-    n = int(meta["n"])
+    Raises ``CheckpointError`` if the file is corrupted, truncated, or a
+    different format version — never returns partial state.
+    """
+    meta, z = _load_npz(path, expect_kind="oracle")
+    try:
+        n = int(meta["n"])
+        agents = meta["agents"]
+    except (KeyError, TypeError, ValueError) as e:
+        raise CheckpointError(f"checkpoint {path!r}: bad meta: {e}") from e
+
+    try:
+        return _rebuild_oracle(z, n, agents)
+    except (KeyError, ValueError, IndexError) as e:
+        raise CheckpointError(
+            f"checkpoint {path!r}: inconsistent contents: {e}") from e
+
+
+def _rebuild_oracle(z, n: int, agents):
+    from ..models.oracle import ClientData, ListCRDT
 
     doc = ListCRDT(capacity=max(n, 64))
     doc.n = n
@@ -109,7 +195,7 @@ def load_doc(path: str):
     doc.chars[:n] = z["chars"]
     doc.frontier = [int(o) for o in z["frontier"]]
 
-    doc.client_data = [ClientData(name) for name in meta["agents"]]
+    doc.client_data = [ClientData(name) for name in agents]
     for a, seq, order, length in z["item_orders"]:
         doc.client_data[int(a)].item_orders.append(
             KOrderSpan(int(seq), int(order), int(length)))
@@ -134,9 +220,7 @@ def save_flat_doc(flat, path: str) -> None:
     """Checkpoint a device ``FlatDoc`` (downloads once). Accepts an
     unbatched doc or a ``stack_docs`` batch (leading doc axis on every
     column, including ``n``/``next_order``)."""
-    np.savez(
-        path,
-        meta=_meta_to_array({"version": FORMAT_VERSION, "kind": "flat"}),
+    arrays = dict(
         signed=np.asarray(flat.signed),
         ol_log=np.asarray(flat.ol_log),
         or_log=np.asarray(flat.or_log),
@@ -145,17 +229,27 @@ def save_flat_doc(flat, path: str) -> None:
         n=np.asarray(flat.n),
         next_order=np.asarray(flat.next_order),
     )
+    _save_npz(path, {"version": FORMAT_VERSION, "kind": "flat"}, arrays)
 
 
 def load_flat_doc(path: str):
-    """Rebuild a device ``FlatDoc`` from a ``save_flat_doc`` checkpoint."""
+    """Rebuild a device ``FlatDoc`` from a ``save_flat_doc`` checkpoint.
+
+    Raises ``CheckpointError`` on corruption/truncation/version mismatch.
+    """
     import jax.numpy as jnp
 
     from ..ops.span_arrays import FlatDoc, I32, U32
 
-    z = np.load(path)
-    meta = _meta_from_array(z["meta"])
-    assert meta.get("kind") == "flat", "not a FlatDoc checkpoint"
+    _, z = _load_npz(path, expect_kind="flat")
+    try:
+        return _rebuild_flat(z, FlatDoc, jnp, I32, U32)
+    except (KeyError, ValueError, IndexError) as e:
+        raise CheckpointError(
+            f"checkpoint {path!r}: inconsistent contents: {e}") from e
+
+
+def _rebuild_flat(z, FlatDoc, jnp, I32, U32):
     return FlatDoc(
         signed=jnp.asarray(z["signed"]),
         ol_log=jnp.asarray(z["ol_log"]),

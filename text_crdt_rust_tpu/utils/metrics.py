@@ -106,6 +106,51 @@ def memory_stats(doc, spans=None) -> dict:
     }
 
 
+class Counters:
+    """Named monotonic counters + high-water gauges for the replication
+    stack (`net/`): frames sent/rejected, retries, buffer high-water.
+
+    The wire-layer analog of the reference's counting-allocator
+    instrumentation (`src/alloc.rs:13-50`): cheap increments everywhere,
+    one ``summary()`` dump. ``incr`` counts events; ``hiwater`` keeps the
+    max of a gauge (e.g. causal-buffer pending size).
+    """
+
+    def __init__(self) -> None:
+        self._counts: Dict[str, int] = {}
+        self._hiwater: Dict[str, int] = {}
+
+    def incr(self, name: str, by: int = 1) -> None:
+        self._counts[name] = self._counts.get(name, 0) + by
+
+    def hiwater(self, name: str, value: int) -> None:
+        if value > self._hiwater.get(name, 0):
+            self._hiwater[name] = value
+
+    def get(self, name: str) -> int:
+        return self._counts.get(name, self._hiwater.get(name, 0))
+
+    def summary(self) -> Dict[str, int]:
+        out = dict(self._counts)
+        for k, v in self._hiwater.items():
+            out[k] = v
+        return out
+
+
+def causal_buffer_stats(buf) -> dict:
+    """Introspection snapshot of a ``parallel.causal.CausalBuffer`` for
+    the session layer and dashboards: pending count and high-water,
+    duplicate-drop / eviction counters, per-agent watermark gaps."""
+    return {
+        "pending": buf.pending,
+        "high_water": buf.high_water,
+        "duplicates_dropped": buf.duplicates_dropped,
+        "evictions": buf.evictions,
+        "watermarks": buf.watermarks(),
+        "agent_gaps": buf.gap_stats(),
+    }
+
+
 class Throughput:
     """Ops/sec accumulator for bench loops.
 
