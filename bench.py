@@ -295,6 +295,7 @@ def make_row(config, engine, n_ops, batch, wall, steps, hbm_bytes,
              base_ops, oracle_equal, **extra):
     total = n_ops * batch
     ops_per_sec = total / wall
+    measured = measured_device_bytes()
     row = {
         "config": config,
         "engine": engine,
@@ -309,11 +310,16 @@ def make_row(config, engine, n_ops, batch, wall, steps, hbm_bytes,
         "mean_step_latency_us": round(wall / steps * 1e6, 3),
         "device_steps": int(steps),
         "hbm_bytes_accounted": int(hbm_bytes),
-        "hbm_bytes_measured": measured_device_bytes(),
+        "hbm_bytes_measured": measured,
         "ops": int(n_ops),
         "batch": int(batch),
         "oracle_equal": bool(oracle_equal),
     }
+    if measured is None:
+        # null + a reason beats a silently absent stat (VERDICT next #5).
+        row["hbm_bytes_measured_note"] = (
+            "runtime exposes no device memory_stats on this platform "
+            "(CPU backend or tunnel device without stats)")
     row.update(_BASELINE_STATS)  # sample spread + loadavg of the denominator
     _BASELINE_STATS.clear()  # consume-once: rows without their own
     #                          baseline call must not inherit stale stats
@@ -422,14 +428,15 @@ def cfg_northstar(args):
     stays equal-workload: the native C++ engine replays the ORIGINAL
     per-patch stream, and ``ops`` counts original patches.
     """
+    from text_crdt_rust_tpu.config import engines_for
     from text_crdt_rust_tpu.ops import blocked as BL
     from text_crdt_rust_tpu.ops import blocked_hbm as BH
     from text_crdt_rust_tpu.ops import rle as R
 
-    if args.engine not in ("rle", "rle-hbm", "blocked", "hbm"):
+    if args.engine not in engines_for("northstar"):
         raise ValueError(
             f"northstar does not implement engine {args.engine!r} "
-            f"(choose rle, rle-hbm, blocked or hbm)")
+            f"(choose one of {engines_for('northstar')})")
     data = load_testing_data(trace_path(args.trace))
     patches = flatten_patches(data)
     if args.patches:
@@ -781,6 +788,31 @@ def _stream_loop(runners, resync_every, ckpt_path, state_keys):
     return res, wall, ckpt_ms, resyncs
 
 
+def _step_latency_pass(runners, chunk_steps):
+    """Per-step latency DISTRIBUTION for the streaming configs (VERDICT
+    next #5): one extra warm re-chain with a hard sync per chunk; each
+    sample is (blocking chunk wall incl. host RTT) / real steps.  Off
+    the timed throughput loop — per-chunk syncs would serialize the
+    pipelining the timed loop exists to measure."""
+    samples = []
+    state = None
+    for run, steps in zip(runners, chunk_steps):
+        t0 = time.perf_counter()
+        res = run(state)
+        np.asarray(res.err)
+        samples.append((time.perf_counter() - t0) / max(steps, 1) * 1e6)
+        state = res.state()
+    ss = sorted(samples)
+    return {
+        "p50_step_latency_us_blocking_incl_rtt":
+            round(ss[len(ss) // 2], 3),
+        "p99_step_latency_us_blocking_incl_rtt":
+            round(ss[min(len(ss) - 1, int(round((len(ss) - 1) * 0.99)))],
+                  3),
+        "step_latency_chunk_samples_us": [round(s, 3) for s in samples],
+    }
+
+
 def cfg_5(args):
     """Config 5: streaming apply over per-doc DIVERGENT streams,
     delete-heavy, with periodic host<->device checkpoint resync.
@@ -814,16 +846,19 @@ def cfg_5(args):
 
     all_chunks = [next_chunk() for _ in range(chunks)]
 
-    # GROWING per-chunk capacity from the engine's row invariant: every
-    # op splices at most 2 new rows (insert splice / delete boundary
-    # splits), so chunk c can never need more than 1 + 2*ops_through(c)
-    # rows — early chunks run on planes ~1/4 the final size instead of
-    # paying the final capacity from chunk 0 (the measured per-lane
-    # high-water after 800 ops is ~820 rows; the bound stays exact, no
-    # sampling).  Each distinct capacity compiles its own kernel
-    # (one-time, pre-warmed below); warm starts zero-pad the planes up.
-    caps = [max(((1 + 2 * steps_per_chunk * (c + 1) + 127) // 128) * 128,
-                256) for c in range(chunks)]
+    # GROWING per-chunk capacity from the engine's row invariant
+    # (batch.row_growth_bound: <= 2 rows per compiled step) — early
+    # chunks run on planes ~1/4 the final size.  The BLOCKED engine
+    # keeps K fixed and grows NB with the capacity (the ISSUE-2 block
+    # refactor), so each chunk's descent is over NB block sums + one
+    # K-row block instead of the whole plane.  Each distinct capacity
+    # compiles its own kernel (one-time, pre-warmed below); warm starts
+    # zero-pad planes and tables up.
+    from text_crdt_rust_tpu.config import lane_block_geometry
+    K5 = args.lanes_block_k
+    caps = [max(lane_block_geometry(
+                B.row_growth_bound(steps_per_chunk * (c + 1)), K5)[0],
+                4 * K5) for c in range(chunks)]
     capacity = caps[-1]
 
     flat0 = [p for ch in all_chunks for p in ch[0]]
@@ -849,8 +884,8 @@ def cfg_5(args):
         stacked = B.stack_ops(opses)
         stacked_all.append(stacked)
         steps += stacked.num_steps
-        runners.append(RL.make_replayer_lanes(
-            stacked, capacity=caps[len(runners)], chunk=128,
+        runners.append(RL.make_replayer_lanes_blocked(
+            stacked, capacity=caps[len(runners)], block_k=K5, chunk=128,
             interpret=args.interpret))
 
     # Warm with ONE full untimed streaming pass: each runner from the
@@ -867,7 +902,10 @@ def cfg_5(args):
     np.asarray(wres.err)
 
     res, wall, ckpt_ms, resyncs = _stream_loop(
-        runners, stream_cfg.resync_every, ckpt, ("ordp", "lenp", "rows"))
+        runners, stream_cfg.resync_every, ckpt,
+        ("ordp", "lenp", "nlog", "blkord", "rws", "liv"))
+    lat = _step_latency_pass(
+        runners, [s.num_steps for s in stacked_all])
 
     ok = True
     for d in range(0, n_docs, max(1, n_docs // 8)):
@@ -888,8 +926,9 @@ def cfg_5(args):
     return make_row("config5_streaming_divergent_resync", "rle-lanes",
                     n_ops, 1, wall, steps, hbm, base_ops, ok,
                     docs=n_docs, chunks=chunks, capacity=capacity,
+                    layout="blocked", lanes_block_k=K5,
                     checkpoint_ms=round(ckpt_ms, 1), resyncs=resyncs,
-                    resync_every=stream_cfg.resync_every)
+                    resync_every=stream_cfg.resync_every, **lat)
 
 
 class _PeerSynth:
@@ -1017,12 +1056,14 @@ def cfg_5_remote(args):
     # GROWING per-chunk capacities (see cfg_5), bounded by COMPILED
     # device steps, not patches: a single <=4-char positional delete can
     # compile into up to 4 KIND_REMOTE_DEL steps (one per target order
-    # run, batch.py target_runs), and every device step adds <= 2 rows,
-    # so chunk c's sound bound is 1 + 2*compiled_steps_through(c)
-    # (pre-padding counts: padded no-op steps add no rows).
+    # run, batch.py target_runs), and every device step adds <= 2 rows
+    # (batch.row_growth_bound; pre-padding counts — padded no-op steps
+    # add no rows).  Blocked layout: K fixed, NB grows with capacity.
+    from text_crdt_rust_tpu.config import lane_block_geometry
+    K5 = args.lanes_block_k
     cum_steps = np.cumsum(real_steps)
-    caps = [max(((1 + 2 * int(cs) + 127) // 128) * 128, 256)
-            for cs in cum_steps]
+    caps = [max(lane_block_geometry(B.row_growth_bound(int(cs)), K5)[0],
+                4 * K5) for cs in cum_steps]
     capacity = caps[-1]
     ocaps = [((lmax * steps_per_chunk * (c + 1) + lmax + 7) // 8) * 8
              for c in range(chunks)]
@@ -1031,8 +1072,9 @@ def cfg_5_remote(args):
     runners = []
     for ci, stacked in enumerate(stacked_all):
         steps += stacked.kind.shape[0]
-        runners.append(RLM.make_replayer_lanes_mixed(
-            stacked, capacity=caps[ci], order_capacity=ocaps[ci],
+        runners.append(RLM.make_replayer_lanes_mixed_blocked(
+            stacked, capacity=caps[ci], block_k=K5,
+            order_capacity=ocaps[ci],
             chunk=128, lane_tile=min(256, n_docs),
             interpret=args.interpret))
 
@@ -1048,7 +1090,9 @@ def cfg_5_remote(args):
     ckpt = os.path.join(tempfile.mkdtemp(prefix="tcr_bench_"), "resync.npz")
     res, wall, ckpt_ms, resyncs = _stream_loop(
         runners, stream_cfg.resync_every, ckpt,
-        ("ordp", "lenp", "rows", "oll", "orl"))
+        ("ordp", "lenp", "nlog", "blkord", "rws", "liv", "raw",
+         "oll", "orl", "ordblk", "fwd"))
+    lat = _step_latency_pass(runners, real_steps)
 
     ok = True
     for d in range(0, n_docs, max(1, n_docs // 8)):
@@ -1067,8 +1111,9 @@ def cfg_5_remote(args):
                     base_ops, ok,
                     docs=n_docs, chunks=chunks, capacity=capacity,
                     order_capacity=ocap,
+                    layout="blocked", lanes_block_k=K5,
                     checkpoint_ms=round(ckpt_ms, 1), resyncs=resyncs,
-                    resync_every=stream_cfg.resync_every)
+                    resync_every=stream_cfg.resync_every, **lat)
 
 
 def _continue_patches(rng, content, steps, ins_prob):
@@ -1185,6 +1230,9 @@ def main() -> None:
                          "for rle, 32768 for rle-hbm; rounded up to a "
                          "block_k multiple)")
     ap.add_argument("--block-k", type=int, default=512)
+    ap.add_argument("--lanes-block-k", type=int, default=64,
+                    help="K (rows per block) for the blocked per-lane "
+                         "engines, configs 5/5r")
     ap.add_argument("--chunk", type=int, default=1024)
     ap.add_argument("--reps", type=int, default=5)
     ap.add_argument("--cpu", action="store_true",

@@ -1,0 +1,507 @@
+"""Kernel-exact step-cost probe for the BLOCKED lanes engines (ISSUE 2):
+touched rows per step, before vs after, on the config-5 and config-5r
+workloads — the on-CPU evidence the PR lands while the TPU tunnel is
+down (`perf/when_up_r6.sh` re-records the real configs on recovery).
+
+Modeled on perf/merge_sim.py: a host replay of the kernels' EXACT row
+algebra (runs, K-row blocks, logical block tables, leaf splits, the
+order->block hint with cold/stale fallbacks) over the same workload
+generators and growing per-chunk capacities bench.py uses, counting two
+metrics per step:
+
+- **touched rows** — unique state/table rows the step's algorithm
+  examines or writes: the un-blocked kernels' position->run scan,
+  splice, and interval clip each span the whole allocated [CAP, B]
+  plane, so an un-blocked step touches CAP rows; a blocked step touches
+  the NBT-row logical table + the K-row target block (+ K+NBT per extra
+  delete block / split / hint fallback).  This is the O(NB+K)-vs-O(CAP)
+  claim the restructure makes, and the acceptance metric (>= 10x).
+- **pass traffic** — row-reads summed over every vector pass the kernel
+  actually makes, including the un-blocked cumsum's log2(CAP) rolls and
+  the blocked kernels' NB-way select-chain gathers (which stream CAP
+  rows to address one block).  This is the honest wall-clock predictor:
+  smaller than the touched-rows ratio because lane-addressed gathers
+  still stream the plane; the chip run decides the final number.
+
+Single-author remote streams (the 5r shape) integrate with a
+first-probe YATA break (each physically-following char either IS the
+op's origin_right or has an earlier-positioned origin_left), so the
+scan cost is one probe — the same count the kernels pay on these
+streams.
+
+Run: python perf/blocked_lanes_sim.py [--docs N] [--block-k K]
+"""
+import argparse
+import math
+import random
+import sys
+
+sys.path.insert(0, ".")
+
+from text_crdt_rust_tpu.config import lane_block_geometry  # noqa: E402
+from text_crdt_rust_tpu.ops.batch import row_growth_bound  # noqa: E402
+
+
+class Counter:
+    def __init__(self):
+        self.unb_touched = 0
+        self.unb_traffic = 0
+        self.blk_touched = 0
+        self.blk_traffic = 0
+        self.steps = 0
+        self.splits = 0
+        self.hint_misses = 0
+        self.hint_probes = 0
+
+
+class UnblockedCost:
+    """Pass counts of the un-blocked kernels (rle_lanes /
+    rle_lanes_mixed): every phase spans the allocated [CAP] plane."""
+
+    def __init__(self, cap):
+        self.cap = cap
+        self.logc = max(1, math.ceil(math.log2(max(cap, 2))))
+
+    def local_insert(self, c: Counter):
+        c.unb_touched += self.cap
+        # live prefix (1 + log2 rolls) + locate reduces (5) + splice (8)
+        c.unb_traffic += (self.logc + 14) * self.cap
+
+    def local_delete(self, c: Counter):
+        c.unb_touched += self.cap
+        # live prefix + clip + two apply_partial transforms
+        c.unb_traffic += (self.logc + 19) * self.cap
+
+    def remote_insert(self, c: Counter, ocap):
+        c.unb_touched += self.cap + 3  # 3 indexed by-order entries
+        # hoisted raw cumsum + cursor_after (3) + 1 scan probe
+        # (3 t_reads over OCAP + cursor_after 3 + run_at 3) + splice 13
+        c.unb_traffic += (self.logc + 22) * self.cap + 3 * ocap
+
+    def remote_delete(self, c: Counter):
+        c.unb_touched += self.cap
+        # interval clip + per-slot updates + two apply_partials
+        c.unb_traffic += 24 * self.cap
+
+
+class BlockedLaneSim:
+    """One lane's EXACT blocked-kernel row algebra: K-row physical
+    blocks, logical block order, leaf splits, liv/raw tables, and the
+    order->block hint with cold/stale fallback accounting."""
+
+    def __init__(self, K, cap, counter, ocap=0):
+        self.K = K
+        self.cap = cap
+        self.ocap = ocap
+        self.c = counter
+        self.nbt = max(8, cap // K)
+        # physical blocks: list of lists of [start_order, length, live]
+        self.blocks = [[]]
+        self.order = [0]      # logical slot -> physical block
+        self.hint = {}        # order -> physical block (may be stale)
+        self.fwd = {}         # block -> split destination (last)
+        self._sb = set()      # per-step: distinct blocks touched
+        self._st = False      # per-step: logical tables examined
+        self._sf = 0          # per-step: whole-plane fallbacks
+        self._se = 0          # per-step: indexed table entries read
+
+    def begin_step(self):
+        self._sb = set()
+        self._st = False
+        self._sf = 0
+        self._se = 0
+
+    def end_step(self):
+        """UNIQUE rows examined this step: each distinct block once,
+        the logical tables once, each plane-scan fallback, each indexed
+        table entry."""
+        self.c.blk_touched += (self.K * len(self._sb)
+                               + (self.nbt if self._st else 0)
+                               + self.cap * self._sf + self._se)
+
+    def grow(self, cap, ocap=0):
+        self.cap = cap
+        self.ocap = ocap
+        self.nbt = max(8, cap // self.K)
+        # hints PERSIST across chunks (the kernel carries ordblk in the
+        # warm-start state tuple)
+
+    # -- bookkeeping ------------------------------------------------------
+
+    def _runs(self):
+        for b in self.order:
+            for r in self.blocks[b]:
+                yield r
+
+    def _locate_order(self, o):
+        """Hint-guided order locate: (block, run) + cost accounting."""
+        self.c.hint_probes += 1
+        self.c.blk_traffic += 2 * self.K + self.ocap  # verify + hint read
+        self._se += 1
+        hb = self.hint.get(o)
+        if hb is not None and hb < len(self.blocks):
+            for r in self.blocks[hb]:
+                if r[0] <= o < r[0] + r[1]:
+                    self._sb.add(hb)
+                    return hb, r
+        # stale hint: chase up to two split forward pointers (one K-row
+        # verify each) before the plane-scan fallback
+        cand = hb
+        for _hop in range(2):
+            cand = self.fwd.get(cand) if cand is not None else None
+            if cand is None or cand >= len(self.blocks):
+                break
+            self.c.blk_traffic += 2 * self.K
+            for r in self.blocks[cand]:
+                if r[0] <= o < r[0] + r[1]:
+                    self._sb.add(cand)
+                    for oo in range(r[0], r[0] + r[1]):
+                        self.hint[oo] = cand
+                    return cand, r
+        # fallback: whole-plane scan + heal the whole found RUN's span
+        self.c.hint_misses += 1
+        self._sf += 1
+        self.c.blk_traffic += self.cap
+        for b in self.order:
+            for r in self.blocks[b]:
+                if r[0] <= o < r[0] + r[1]:
+                    for oo in range(r[0], r[0] + r[1]):
+                        self.hint[oo] = b
+                    return b, r
+        raise AssertionError(f"order {o} absent")
+
+    def _slot_of_live(self, rank1):
+        self._st = True
+        self.c.blk_traffic += self.nbt
+        before = 0
+        for li, b in enumerate(self.order):
+            lv = sum(r[1] for r in self.blocks[b] if r[2])
+            if before + lv >= rank1:
+                return li, before
+            before += lv
+        return len(self.order) - 1, before - lv
+
+    def _slot_of_raw(self, rank1):
+        self._st = True
+        self.c.blk_traffic += self.nbt
+        before = 0
+        for li, b in enumerate(self.order):
+            rw = sum(r[1] for r in self.blocks[b])
+            if before + rw >= rank1:
+                return li, before
+            before += rw
+        return len(self.order) - 1, before - rw
+
+    def _maybe_split(self, li):
+        """Returns True when a split fired (the kernel re-descends
+        under ``lax.cond`` only then)."""
+        b = self.order[li]
+        if len(self.blocks[b]) + 2 <= self.K:
+            return False
+        assert len(self.blocks) < self.cap // self.K, "out of blocks"
+        rows = self.blocks[b]
+        keep = len(rows) // 2
+        nb = len(self.blocks)
+        self.blocks.append(rows[keep:])
+        self.blocks[b] = rows[:keep]
+        self.order.insert(li + 1, nb)
+        # moved rows' hints go stale (NOT updated — kernel heals on
+        # probe); cost: gather + two scatters + table shift
+        self.c.splits += 1
+        self.fwd[b] = nb
+        self._sb.add(b)
+        self._sb.add(nb)
+        self._st = True
+        self.c.blk_traffic += 4 * self.cap + self.nbt
+        return True
+
+    def _block_cost(self, b):
+        """One gathered-block locate + splice of block ``b``."""
+        self._sb.add(b)
+        # gather x2 + in-block cumsum/splice (~log2 K + 10 K-passes)
+        # + scatter x2 (each streams the plane in the select chain)
+        self.c.blk_traffic += 4 * self.cap + \
+            (math.ceil(math.log2(self.K)) + 10) * self.K
+
+    # -- ops --------------------------------------------------------------
+
+    def insert_local(self, pos, il, st):
+        li, before = self._slot_of_live(pos) if pos else (0, 0)
+        if self._maybe_split(li):
+            li, before = self._slot_of_live(pos) if pos else (0, 0)
+        b = self.order[li]
+        self._block_cost(b)
+        rows = self.blocks[b]
+        local = pos - before
+        if pos == 0:
+            rows.insert(0, [st, il, True])
+        else:
+            at = 0
+            for i, r in enumerate(rows):
+                lv = r[1] if r[2] else 0
+                if at + lv >= local:
+                    off_live = local - at
+                    # char offset of the off_live-th live char's end
+                    off = off_live
+                    if r[2] and off == r[1] and st == r[0] + r[1]:
+                        r[1] += il
+                    elif off == r[1]:
+                        rows.insert(i + 1, [st, il, True])
+                    elif off < r[1]:
+                        tail = [r[0] + off, r[1] - off, r[2]]
+                        rows[i: i + 1] = [[r[0], off, r[2]],
+                                          [st, il, True], tail]
+                    break
+                at += lv
+        for o in range(st, st + il):
+            self.hint[o] = b
+
+    def delete_local(self, pos, d):
+        rem = d
+        while rem > 0:
+            li, before = self._slot_of_live(pos + 1)
+            if self._maybe_split(li):
+                li, before = self._slot_of_live(pos + 1)
+            b = self.order[li]
+            self._block_cost(b)
+            rows = self.blocks[b]
+            # One block pass mirrors the kernel exactly: pre-delete
+            # cumsums, ``rem`` held fixed for the whole pass.
+            covered = 0
+            out = []
+            at = before
+            for r in rows:
+                lv = r[1] if r[2] else 0
+                cs = min(max(pos - at, 0), lv)
+                ce = min(max(pos + rem - at, 0), lv)
+                cov = ce - cs
+                if cov > 0:
+                    if cs > 0:
+                        out.append([r[0], cs, True])
+                    out.append([r[0] + cs, cov, False])
+                    if ce < r[1]:
+                        out.append([r[0] + ce, r[1] - ce, True])
+                    covered += cov
+                else:
+                    out.append(r)
+                at += lv
+            self.blocks[b] = out
+            if covered == 0:
+                raise AssertionError("delete past end")
+            rem -= covered
+
+    def remote_insert(self, o_left, il, st):
+        # cursor_after: hint locate + slot inverse + in-block prefix
+        if o_left is not None:
+            hb, r = self._locate_order(o_left)
+            self._st = True
+            self.c.blk_traffic += self.nbt + self.K
+            # raw position of o_left + 1
+            raw = 0
+            for b in self.order:
+                if b == hb:
+                    break
+                raw += sum(x[1] for x in self.blocks[b])
+            for x in self.blocks[hb]:
+                if x is r:
+                    break
+                raw += x[1]
+            cursor = raw + (o_left - r[0]) + 1
+        else:
+            cursor = 0
+        # one YATA probe (first-probe break on single-author streams):
+        # run_at_raw descent+gather + 3 table reads + cursor_after of
+        # the probed char's origin_left (its block joins the step set)
+        self._st = True
+        self._se += 4
+        raw_at = 0
+        for pb in self.order:
+            w = sum(x[1] for x in self.blocks[pb])
+            if raw_at + w > cursor:
+                self._sb.add(pb)
+                break
+            raw_at += w
+        self.c.blk_traffic += self.nbt + 3 * self.K + 3 * self.ocap \
+            + self.nbt
+        # splice at raw cursor
+        li, before = self._slot_of_raw(cursor) if cursor else (0, 0)
+        if self._maybe_split(li):
+            li, before = self._slot_of_raw(cursor) if cursor else (0, 0)
+        b = self.order[li]
+        self._block_cost(b)
+        rows = self.blocks[b]
+        local = cursor - before
+        if cursor == 0:
+            rows.insert(0, [st, il, True])
+        else:
+            at = 0
+            for i, r in enumerate(rows):
+                if at + r[1] >= local:
+                    off = local - at
+                    if (r[2] and off == r[1] and st == r[0] + r[1]
+                            and o_left == r[0] + r[1] - 1):
+                        r[1] += il
+                    elif off == r[1]:
+                        rows.insert(i + 1, [st, il, True])
+                    else:
+                        tail = [r[0] + off, r[1] - off, r[2]]
+                        rows[i: i + 1] = [[r[0], off, r[2]],
+                                          [st, il, True], tail]
+                    break
+                at += r[1]
+        for o in range(st, st + il):
+            self.hint[o] = b
+
+    def remote_delete(self, t, d):
+        o = t
+        end = t + d
+        while o < end:
+            hb, r = self._locate_order(o)
+            li = self.order.index(hb)
+            self._st = True
+            self.c.blk_traffic += self.nbt
+            aa = o - r[0]
+            ee = min(r[1], end - r[0])
+            cov = ee - aa
+            if r[2]:
+                if aa == 0 and ee == r[1]:
+                    r[2] = False
+                    self._sb.add(hb)
+                    self.c.blk_traffic += 2 * self.cap + self.K
+                else:
+                    if self._maybe_split(li):
+                        hb, r = self._locate_order(o)
+                    rows = self.blocks[hb]
+                    i = rows.index(r)
+                    parts = []
+                    if aa > 0:
+                        parts.append([r[0], aa, True])
+                    parts.append([r[0] + aa, cov, False])
+                    if ee < r[1]:
+                        parts.append([r[0] + ee, r[1] - ee, True])
+                    rows[i: i + 1] = parts
+                    self._block_cost(hb)
+            o = r[0] + ee
+
+
+def config5_workload(docs, chunks, steps_per_chunk, block_k, remote):
+    """Replay the bench config-5/5r workload shape through both cost
+    models (same generators and growing capacities as bench.py)."""
+    from bench import _PeerSynth, _continue_patches
+    from text_crdt_rust_tpu.ops import batch as B
+
+    c = Counter()
+    rngs = [random.Random((7000 if remote else 1000) + d)
+            for d in range(docs)]
+    contents = [""] * docs
+    synths = [_PeerSynth(f"peer{d}") for d in range(docs)]
+    tables = [B.AgentTable([f"peer{d}"]) for d in range(docs)]
+    assigners = [None] * docs
+    sims = [None] * docs
+    caps = []
+    cum_steps = 0
+    for ci in range(chunks):
+        chunk_ops = []
+        for d in range(docs):
+            patches, contents[d] = _continue_patches(
+                rngs[d], contents[d], steps_per_chunk, ins_prob=0.45)
+            if remote:
+                txns = synths[d].apply(patches)
+                ops, assigners[d] = B.compile_remote_txns(
+                    txns, tables[d], assigner=assigners[d], lmax=4,
+                    dmax=None)
+            else:
+                start = assigners[d] or 0
+                ops, assigners[d] = B.compile_local_patches(
+                    patches, lmax=4, dmax=None, start_order=start)
+            chunk_ops.append(ops)
+        cum_steps += max(o.num_steps for o in chunk_ops)
+        cap = max(lane_block_geometry(row_growth_bound(cum_steps),
+                                      block_k)[0], 4 * block_k)
+        caps.append(cap)
+        unb = UnblockedCost(cap)
+        for d, ops in enumerate(chunk_ops):
+            ocap = 4 * steps_per_chunk * (ci + 1) + 4
+            if sims[d] is None:
+                sims[d] = BlockedLaneSim(block_k, cap, c, ocap)
+            else:
+                sims[d].grow(cap, ocap)
+            sim = sims[d]
+            import numpy as np
+            kind = np.asarray(ops.kind)
+            pos = np.asarray(ops.pos)
+            dln = np.asarray(ops.del_len)
+            dtg = np.asarray(ops.del_target)
+            olp = np.asarray(ops.origin_left).astype(np.int64)
+            iln = np.asarray(ops.ins_len)
+            stt = np.asarray(ops.ins_order_start)
+            for s in range(ops.num_steps):
+                k, p, dl, il = (int(kind[s]), int(pos[s]), int(dln[s]),
+                                int(iln[s]))
+                st = int(stt[s])
+                if k == 0 and dl:
+                    c.steps += 1
+                    unb.local_delete(c)
+                    sim.begin_step()
+                    sim.delete_local(p, dl)
+                    sim.end_step()
+                if k == 0 and il:
+                    c.steps += 1
+                    unb.local_insert(c)
+                    sim.begin_step()
+                    sim.insert_local(p, il, st)
+                    sim.end_step()
+                if k == 1 and il:
+                    c.steps += 1
+                    unb.remote_insert(c, sim.ocap)
+                    ol = None if olp[s] == 0xFFFFFFFF else int(olp[s])
+                    sim.begin_step()
+                    sim.remote_insert(ol, il, st)
+                    sim.end_step()
+                if k == 2 and dl:
+                    c.steps += 1
+                    unb.remote_delete(c)
+                    sim.begin_step()
+                    sim.remote_delete(int(dtg[s]), dl)
+                    sim.end_step()
+    return c, caps
+
+
+def report(name, c: Counter, caps):
+    tr = c.unb_touched / max(c.blk_touched, 1)
+    pr = c.unb_traffic / max(c.blk_traffic, 1)
+    print(f"{name}: caps {caps[0]}..{caps[-1]}, {c.steps} steps, "
+          f"{c.splits} splits, hint misses "
+          f"{c.hint_misses}/{max(c.hint_probes, 1)}")
+    print(f"  touched rows/step: unblocked {c.unb_touched / c.steps:.0f}"
+          f" vs blocked {c.blk_touched / c.steps:.0f}  -> "
+          f"{tr:.1f}x fewer")
+    print(f"  pass traffic/step: unblocked "
+          f"{c.unb_traffic / c.steps:.0f} vs blocked "
+          f"{c.blk_traffic / c.steps:.0f}  -> {pr:.1f}x less")
+    return tr, pr
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--docs", type=int, default=48,
+                    help="lanes to simulate (iid workload; bench runs "
+                         "2048 of the same distribution)")
+    ap.add_argument("--chunks", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--block-k", type=int, default=32)
+    args = ap.parse_args()
+    c5, caps5 = config5_workload(args.docs, args.chunks, args.steps,
+                                 args.block_k, remote=False)
+    t5, _ = report("config 5  (local lanes)", c5, caps5)
+    c5r, caps5r = config5_workload(args.docs, args.chunks, args.steps,
+                                   args.block_k, remote=True)
+    t5r, _ = report("config 5r (remote lanes)", c5r, caps5r)
+    ok = t5 >= 10 and t5r >= 10
+    print(f"acceptance (>=10x touched-rows on both): "
+          f"{'PASS' if ok else 'FAIL'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

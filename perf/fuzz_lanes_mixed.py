@@ -71,7 +71,10 @@ def lane_stream(rng, seed):
     return out
 
 
-def one_round(seed: int) -> int:
+def one_round(seed: int, layouts=("flat", "blocked")) -> int:
+    """One fuzz round: every requested layout must match the oracle AND
+    (when both run) each other bit-identically — the ISSUE-2 blocked /
+    un-blocked differential ride-along."""
     rng = random.Random(seed)
     lanes = 3 + rng.randrange(4)
     lane_txns = [lane_stream(rng, seed * 100 + k) for k in range(lanes)]
@@ -86,9 +89,16 @@ def one_round(seed: int) -> int:
         ops, _ = B.compile_remote_txns(txns, table, lmax=6, dmax=None)
         opses.append(ops)
     stacked = B.stack_ops(opses)
-    res = RLM.replay_lanes_mixed(stacked, capacity=1024, chunk=32,
-                                 interpret=True)
-    res.check()
+    results = {}
+    if "flat" in layouts:
+        results["flat"] = RLM.replay_lanes_mixed(
+            stacked, capacity=1024, chunk=32, interpret=True)
+    if "blocked" in layouts:
+        results["blocked"] = RLM.replay_lanes_mixed_blocked(
+            stacked, capacity=1024, block_k=64, chunk=32,
+            interpret=True)
+    for r in results.values():
+        r.check()
     n_ops = 0
     for d, txns in enumerate(lane_txns):
         oracle = ListCRDT()
@@ -96,9 +106,16 @@ def one_round(seed: int) -> int:
             oracle.apply_remote_txn(t)
         want = [(-1 if oracle.deleted[i] else 1)
                 * (int(oracle.order[i]) + 1) for i in range(oracle.n)]
-        got = RL.expand_lane(res, d).tolist()
-        assert got == want, f"seed {seed} lane {d} DIVERGED"
+        for name, res in results.items():
+            got = RL.expand_lane(res, d).tolist()
+            assert got == want, f"seed {seed} lane {d} {name} DIVERGED"
         n_ops += oracle.n
+    if len(results) == 2:
+        assert np.array_equal(np.asarray(results["flat"].ol),
+                              np.asarray(results["blocked"].ol)) \
+            and np.array_equal(np.asarray(results["flat"].orr),
+                               np.asarray(results["blocked"].orr)), \
+            f"seed {seed}: blocked origins diverged from flat"
     return n_ops
 
 
@@ -106,12 +123,16 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rounds", type=int, default=200)
     ap.add_argument("--start-seed", type=int, default=10_000)
+    ap.add_argument("--layout", default="both",
+                    choices=("both", "flat", "blocked"))
     args = ap.parse_args()
+    layouts = (("flat", "blocked") if args.layout == "both"
+               else (args.layout,))
     t0 = time.time()
     total = 0
     for k in range(args.rounds):
         seed = args.start_seed + k
-        total += one_round(seed)
+        total += one_round(seed, layouts)
         if (k + 1) % 10 == 0:
             print(f"{k + 1}/{args.rounds} rounds, {total} chars checked, "
                   f"{time.time() - t0:.0f}s", flush=True)

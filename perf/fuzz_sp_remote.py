@@ -65,7 +65,7 @@ def peer(rng, n, agent):
     return d
 
 
-def one_round(doc: SpDoc, seed: int) -> int:
+def one_round(doc: SpDoc, seed: int, lanes_diff: bool = True) -> int:
     rng = random.Random(seed)
     reset(doc)
     oracle = ListCRDT()
@@ -91,6 +91,28 @@ def one_round(doc: SpDoc, seed: int) -> int:
                 * (int(oracle.order[i]) + 1) for i in range(oracle.n)]
         got = doc.expand().tolist()
         assert got == want, f"seed {seed} chunk@{at} DIVERGED"
+    if lanes_diff:
+        # ISSUE-2 ride-along: the same stream through the BLOCKED and
+        # un-blocked per-lane mixed engines must match the oracle (and
+        # therefore the sharded SpDoc) bit-identically.
+        from text_crdt_rust_tpu.ops import rle_lanes as RL
+        from text_crdt_rust_tpu.ops import rle_lanes_mixed as RLM
+
+        ops_all, _ = B.compile_remote_txns(txns, table, lmax=6,
+                                           dmax=None)
+        stacked = B.stack_ops([ops_all])
+        want = [(-1 if oracle.deleted[i] else 1)
+                * (int(oracle.order[i]) + 1) for i in range(oracle.n)]
+        for name, res in (
+            ("flat", RLM.replay_lanes_mixed(
+                stacked, capacity=512, chunk=32, interpret=True)),
+            ("blocked", RLM.replay_lanes_mixed_blocked(
+                stacked, capacity=512, block_k=32, chunk=32,
+                interpret=True)),
+        ):
+            res.check()
+            assert RL.expand_lane(res, 0).tolist() == want, \
+                f"seed {seed} lanes-{name} DIVERGED"
     return oracle.n
 
 

@@ -1,0 +1,149 @@
+"""Tier-1 differential fuzz: BLOCKED vs un-blocked lanes engines vs
+the oracle (ISSUE-2 acceptance: >= 50 seeds per driver family inside
+the tier-1 budget; the deep variants run under ``-m slow`` and in
+``perf/fuzz_lanes_mixed.py`` / ``perf/fuzz_sp_remote.py``).
+
+Every seed's streams pad to ONE fixed device shape, so all seeds share
+a single trace per engine — the fixed-shape trick that makes a 50-seed
+interpret-mode fuzz cost seconds, not hours.
+"""
+import random
+
+import numpy as np
+import pytest
+
+from text_crdt_rust_tpu.models.oracle import ListCRDT
+from text_crdt_rust_tpu.models.sync import export_txns_since
+from text_crdt_rust_tpu.ops import batch as B
+from text_crdt_rust_tpu.ops import rle_lanes as RL
+from text_crdt_rust_tpu.ops import rle_lanes_mixed as RLM
+from text_crdt_rust_tpu.utils.randedit import make_storm, random_patches
+
+SMAX = 64     # fixed padded step count (every stream must compile under)
+CAPF = 128    # fixed run-row capacity
+KF = 16       # block_k (tiny: every seed exercises splits)
+OCAPF = 256   # fixed by-order table rows
+LANES = 2
+
+
+def _peer(rng, n, agent):
+    doc = ListCRDT()
+    a = doc.get_or_create_agent_id(agent)
+    patches, _ = random_patches(rng, n)
+    for p in patches:
+        if p.del_len:
+            doc.local_delete(a, p.pos, p.del_len)
+        if p.ins_content:
+            doc.local_insert(a, p.pos, p.ins_content)
+    return doc
+
+
+def _lane_stream(rng, seed):
+    """One lane's txn stream: a random hard shape (the
+    perf/fuzz_lanes_mixed generator, sized for the fixed SMAX)."""
+    shape = rng.randrange(3)
+    if shape == 0:  # two-peer merge
+        txns = []
+        for name in ("ann", "bob"):
+            txns.extend(export_txns_since(
+                _peer(rng, 5 + rng.randrange(6), name), 0))
+        return txns
+    if shape == 1:  # concurrent storm with cross-peer deletes
+        txns, _ = make_storm(2, 2 + rng.randrange(2),
+                             1 + rng.randrange(2), seed=seed,
+                             del_prob=0.25 + rng.random() * 0.2)
+        return txns
+    # interleaved independent peers
+    streams = [export_txns_since(_peer(rng, 4 + rng.randrange(5), n), 0)
+               for n in ("kim", "lou")]
+    out = []
+    queues = [list(s) for s in streams]
+    while any(queues):
+        live = [q for q in queues if q]
+        out.append(rng.choice(live).pop(0))
+    return out
+
+
+def _compile_fixed(lane_txns):
+    opses = []
+    for txns in lane_txns:
+        table = B.AgentTable()
+        for t in txns:
+            table.add(t.id.agent)
+            for op in t.ops:
+                if hasattr(op, "id"):
+                    table.add(op.id.agent)
+        ops, _ = B.compile_remote_txns(txns, table, lmax=4, dmax=None)
+        assert ops.num_steps <= SMAX, f"bump SMAX: {ops.num_steps}"
+        opses.append(B.pad_ops(ops, SMAX))
+    return B.stack_ops(opses)
+
+
+def _one_round(seed):
+    rng = random.Random(seed)
+    lane_txns = [_lane_stream(rng, seed * 100 + k) for k in range(LANES)]
+    stacked = _compile_fixed(lane_txns)
+    kw = dict(capacity=CAPF, order_capacity=OCAPF, chunk=32,
+              interpret=True)
+    flat = RLM.replay_lanes_mixed(stacked, **kw)
+    blk = RLM.replay_lanes_mixed_blocked(stacked, block_k=KF, **kw)
+    flat.check()
+    blk.check()
+    for d, txns in enumerate(lane_txns):
+        oracle = ListCRDT()
+        for t in txns:
+            oracle.apply_remote_txn(t)
+        want = [(-1 if oracle.deleted[i] else 1)
+                * (int(oracle.order[i]) + 1) for i in range(oracle.n)]
+        assert RL.expand_lane(flat, d).tolist() == want, \
+            f"seed {seed} lane {d} flat DIVERGED"
+        assert RL.expand_lane(blk, d).tolist() == want, \
+            f"seed {seed} lane {d} blocked DIVERGED"
+    assert np.array_equal(np.asarray(flat.ol), np.asarray(blk.ol))
+    assert np.array_equal(np.asarray(flat.orr), np.asarray(blk.orr))
+
+
+class TestLanesMixedFuzz:
+    def test_60_seeds_blocked_vs_flat_vs_oracle(self):
+        for seed in range(60):
+            _one_round(seed)
+
+    @pytest.mark.slow
+    def test_300_more_seeds(self):
+        for seed in range(60, 360):
+            _one_round(seed)
+
+
+class TestSpRemoteRideAlong:
+    """The sharded SpDoc fuzz shape with the blocked/un-blocked lanes
+    differential riding along (perf/fuzz_sp_remote's round, fixed device
+    shapes).  SpDoc itself is exercised by tests/test_sp_apply.py and
+    the perf driver; this tier-1 pass holds the lanes engines to the
+    same streams."""
+
+    def _round(self, seed):
+        rng = random.Random(seed)
+        oracle = ListCRDT()
+        txns = (export_txns_since(_peer(rng, 6 + rng.randrange(8),
+                                        "pa"), 0)
+                + export_txns_since(_peer(rng, 6 + rng.randrange(8),
+                                          "pb"), 0))
+        for t in txns:
+            oracle.apply_remote_txn(t)
+        stacked = _compile_fixed([txns])
+        want = [(-1 if oracle.deleted[i] else 1)
+                * (int(oracle.order[i]) + 1) for i in range(oracle.n)]
+        kw = dict(capacity=CAPF, order_capacity=OCAPF, chunk=32,
+                  interpret=True)
+        for name, res in (
+            ("flat", RLM.replay_lanes_mixed(stacked, **kw)),
+            ("blocked", RLM.replay_lanes_mixed_blocked(
+                stacked, block_k=KF, **kw)),
+        ):
+            res.check()
+            assert RL.expand_lane(res, 0).tolist() == want, \
+                f"seed {seed} {name} DIVERGED"
+
+    def test_50_seeds(self):
+        for seed in range(40_000, 40_050):
+            self._round(seed)

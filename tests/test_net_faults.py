@@ -111,9 +111,17 @@ def assert_oracle_convergence(sa: ResyncSession, sb: ResyncSession) -> None:
     assert not sa.divergence_detected and not sb.divergence_detected
 
 
+OCAP_LANES = 1024  # fixed by-order table rows for the lanes ride-along
+
+
 def assert_device_convergence(doc: ListCRDT) -> None:
-    """Replay the converged history through the flat device engine:
-    bit-identical doc_spans vs this peer's oracle."""
+    """Replay the converged history through the flat device engine AND
+    the per-lane mixed engines (blocked + un-blocked): bit-identical
+    state vs this peer's oracle.  Every shape is fixed (SMAX/CAP/OCAP)
+    so all seeds share one trace per engine."""
+    from text_crdt_rust_tpu.ops import rle_lanes as RL
+    from text_crdt_rust_tpu.ops import rle_lanes_mixed as RLM
+
     table = B.AgentTable(sorted(cd.name for cd in doc.client_data))
     txns = export_txns_since(doc, 0)
     ops, _ = B.compile_remote_txns(txns, table, lmax=LMAX)
@@ -121,6 +129,23 @@ def assert_device_convergence(doc: ListCRDT) -> None:
     flat = F.apply_ops(SA.make_flat_doc(CAP), B.pad_ops(ops, SMAX))
     assert SA.doc_spans(flat) == doc.doc_spans()
     assert SA.to_string(flat) == doc.to_string()
+
+    # ISSUE-2 ride-along: the blocked lanes engines must survive the
+    # fault-injection mesh bit-identically too.
+    import numpy as np
+
+    adv = int(np.asarray(ops.order_advance, dtype=np.int64).sum())
+    assert adv + ops.lmax <= OCAP_LANES, f"bump OCAP_LANES: {adv}"
+    stacked = B.stack_ops([B.pad_ops(ops, SMAX)])
+    want = [(-1 if doc.deleted[i] else 1) * (int(doc.order[i]) + 1)
+            for i in range(doc.n)]
+    kw = dict(capacity=CAP, order_capacity=OCAP_LANES, chunk=128,
+              interpret=True)
+    for res in (RLM.replay_lanes_mixed(stacked, **kw),
+                RLM.replay_lanes_mixed_blocked(stacked, block_k=64,
+                                               **kw)):
+        res.check()
+        assert RL.expand_lane(res, 0).tolist() == want
 
 
 def _fuzz_seed_range(seeds):

@@ -11,7 +11,12 @@ import random
 
 import pytest
 
-from text_crdt_rust_tpu.common import (
+# Heavy interpret-mode matrix: slow tier (VERDICT weak #7).  Tier-1
+# keeps rle-mixed coverage via test_rle_mixed_fast.TestTier1Smoke and
+# the blocked-lanes fuzz.
+pytestmark = pytest.mark.slow
+
+from text_crdt_rust_tpu.common import (  # noqa: E402
     RemoteDel,
     RemoteId,
     RemoteIns,

@@ -55,6 +55,23 @@ def assert_fast_exact(txns, capacity=512):
                           np.asarray(serial.signed))
 
 
+class TestTier1Smoke:
+    """Tier-1 representative of the fast-vs-serial property (the full
+    matrix below runs under ``-m slow``): one small storm with deletes
+    through both scan paths, bit-identical and oracle-equal."""
+
+    def test_small_delete_storm(self):
+        txns, receiver = make_storm(3, 4, 2, seed=7, del_prob=0.3)
+        fast, serial = replay_both(txns, capacity=256, chunk=32)
+        want = oracle_txns(txns).to_string()
+        assert want == receiver.to_string()
+        assert SA.to_string(serial) == want
+        assert SA.to_string(fast) == want
+        assert np.array_equal(np.asarray(fast.signed),
+                              np.asarray(serial.signed))
+
+
+@pytest.mark.slow
 class TestFastIntegrate:
     def test_insert_storm(self):
         # The config-4 shape: every window run is a ROOT-origin sibling.
@@ -132,6 +149,45 @@ class TestFastIntegrate:
 
         # Receiver integrates in both causal orders.
         for stream in ([*t1, *t2, *t3], [*t1, *t3, *t2]):
+            assert_fast_exact(stream, capacity=256)
+
+    def test_split_tail_requalifies_as_sibling(self):
+        # ADVICE r5 item 3: an insert-split used to poison the tail's
+        # aux origin-right with -2, forcing the serial walk forever on
+        # any window holding it.  The tail's TRUE origin-right is now
+        # read from the orl table at split time, so a later concurrent
+        # sibling probing a window that contains the split tail must
+        # classify it exactly (same tiebreak outcome as the serial
+        # walk and the oracle), in both causal orders.
+        def typed(name, see, edit):
+            doc = ListCRDT()
+            agent = doc.get_or_create_agent_id(name)
+            for t in see:
+                doc.apply_remote_txn(t)
+            m = doc.get_next_order()
+            edit(doc, agent)
+            return export_txns_since(doc, m)
+
+        # mmm types "ab", APPENDS "cd" (merge-appends into one run
+        # [abcd]; c's table origin-right is ROOT, the head's is not),
+        # then SPLITS at 2 with "Q" -> [ab][Q][cd].  The tail [cd]'s
+        # head chains to b, and its orl entry (ROOT) differs from the
+        # head run's — exactly the "unknowable from the head" case.
+        t1 = typed("mmm", [], lambda d, g: d.local_insert(g, 0, "ab"))
+        t2 = typed("mmm", t1, lambda d, g: d.local_insert(g, 2, "cd"))
+        t3 = typed("mmm", [*t1, *t2],
+                   lambda d, g: d.local_insert(g, 2, "Q"))
+        # Concurrent peers who saw ONLY "ab" insert after b with
+        # origin_right ROOT: their scan windows run to the doc end and
+        # contain the split tail as a SIBLING (origin_left == b ==
+        # the tail head's) — zzz outranks mmm (scan continues past),
+        # aaa ranks below with a matching origin-right (breaks AT the
+        # tail), covering both tiebreak arms of the repaired path.
+        t4 = typed("zzz", t1, lambda d, g: d.local_insert(g, 2, "z"))
+        t5 = typed("aaa", t1, lambda d, g: d.local_insert(g, 2, "a"))
+        for stream in ([*t1, *t2, *t3, *t4, *t5],
+                       [*t1, *t2, *t4, *t3, *t5],
+                       [*t1, *t2, *t5, *t4, *t3]):
             assert_fast_exact(stream, capacity=256)
 
     def test_pseudo_breaker_beats_stale_window_kss(self):

@@ -13,11 +13,53 @@ import argparse
 import dataclasses
 from typing import Optional, Sequence
 
-# The one canonical engine-name tuple (advisor r3: bench.py and
-# EngineConfig had drifted apart). Every CLI choice list derives from
-# this; ops/__init__ exposes the same names.
-ENGINE_CHOICES = ("rle", "rle-hbm", "rle-lanes", "rle-mixed", "blocked",
-                  "blocked-mixed", "hbm", "flat")
+# The ONE canonical engine registry (advisor r3: bench.py and
+# EngineConfig had drifted apart; VERDICT r5 weak #6: rle-lanes-mixed
+# was missing from the choices entirely).  Maps the public engine name
+# to its implementing module (relative to this package) and the bench
+# configs that exercise it.  Every CLI choice list and bench dispatch
+# derives from this dict; ``tests/test_engine_registry.py`` asserts the
+# README table and bench.py name no engine outside it.
+ENGINE_REGISTRY = {
+    "rle":             {"module": "ops.rle", "configs": ("northstar", "2", "3")},
+    "rle-hbm":         {"module": "ops.rle_hbm", "configs": ("northstar", "kevin")},
+    "rle-lanes":       {"module": "ops.rle_lanes", "configs": ("5",)},
+    "rle-mixed":       {"module": "ops.rle_mixed", "configs": ("4",)},
+    "rle-lanes-mixed": {"module": "ops.rle_lanes_mixed", "configs": ("5r",)},
+    "blocked":         {"module": "ops.blocked", "configs": ("northstar",)},
+    "blocked-mixed":   {"module": "ops.blocked_mixed", "configs": ("4",)},
+    "hbm":             {"module": "ops.blocked_hbm", "configs": ("northstar",)},
+    "flat":            {"module": "ops.flat", "configs": ()},
+}
+ENGINE_CHOICES = tuple(ENGINE_REGISTRY)
+
+# Bench-row labels that are not registry engine names: variants mapping
+# to a registry engine, or host baselines (None) that have no device
+# module.  The registry-consistency test walks bench.py and README
+# through this map — any NEW label must land here or in the registry.
+ENGINE_ROW_ALIASES = {
+    "rle-groups": "rle",       # config 3: rle engine, doc-group grid axis
+    "native-cpp": None,        # host C++ baseline
+    "gap-buffer": None,        # text-only rope lower bound
+}
+
+
+def engines_for(config_key: str) -> tuple:
+    """Engine names registered as valid for one bench config key —
+    bench.py's per-config dispatch derives from the registry instead of
+    private literal tuples."""
+    return tuple(n for n, spec in ENGINE_REGISTRY.items()
+                 if config_key in spec["configs"])
+
+
+def lane_block_geometry(capacity: int, block_k: int) -> tuple:
+    """Blocked-lanes geometry for a requested per-lane row capacity:
+    ``(capacity, NB, NBT)`` with capacity rounded UP to a ``block_k``
+    multiple (K is fixed across a stream's chunks; the growing
+    per-chunk capacities of configs 5/5r size NB, not K)."""
+    cap = ((capacity + block_k - 1) // block_k) * block_k
+    nb = cap // block_k
+    return cap, nb, max(8, nb)
 
 
 @dataclasses.dataclass
@@ -29,6 +71,10 @@ class EngineConfig:
     #                            northstar optimum; 512+ exceeds VMEM,
     #                            PERF.md §5)
     block_k: int = 256         # rows per block (rle: RUN rows)
+    lanes_block_k: int = 64    # K for the BLOCKED per-lane engines
+    #                            (configs 5/5r): small enough that the
+    #                            in-block splice is cheap, large enough
+    #                            that NB stays a few dozen (PERF.md §9)
     chunk: int = 1024          # ops per grid step (TPU wants %1024)
     capacity: int = 0          # state rows; 0 = per-workload default
     lmax_cap: int = 512        # insert-chunk cap when compiling merged ops
@@ -39,6 +85,8 @@ class EngineConfig:
                         choices=ENGINE_CHOICES)
         ap.add_argument("--batch", type=int, default=self.batch)
         ap.add_argument("--block-k", type=int, default=self.block_k)
+        ap.add_argument("--lanes-block-k", type=int,
+                        default=self.lanes_block_k)
         ap.add_argument("--chunk", type=int, default=self.chunk)
         ap.add_argument("--capacity", type=int, default=self.capacity)
         ap.add_argument("--interpret", action="store_true",

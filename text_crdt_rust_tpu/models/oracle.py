@@ -80,6 +80,12 @@ class ListCRDT:
         self.deleted = np.zeros(capacity, dtype=bool)
         self.chars = np.zeros(capacity, dtype=np.uint32)  # unicode codepoints
         self.n = 0
+        # order -> raw body index, maintained through every splice — the
+        # oracle's SpaceIndex (`markers.rs:8`): ``raw_index_of_order`` is
+        # one array read instead of a full-body np.nonzero scan (the
+        # per-probed-char cost that capped differential-fuzz throughput).
+        # -1 = order not in the body.
+        self._raw_index = np.full(capacity, -1, dtype=np.int64)
 
         # Frontier starts at ROOT (`doc.rs:54`).
         self.frontier: List[int] = [ROOT_ORDER]
@@ -167,12 +173,29 @@ class ListCRDT:
             new[: self.n] = old[: self.n]
             setattr(self, name, new)
 
+    def rebuild_raw_index(self) -> None:
+        """Recompute the order->raw-index map from the body — for
+        restore paths that set the body columns directly instead of
+        splicing (``utils.checkpoint._rebuild_oracle``)."""
+        n = self.n
+        orders = self.order[:n].astype(np.int64)
+        top = int(orders.max(initial=0)) + 1
+        if top > len(self._raw_index):
+            self._raw_index = np.full(top, -1, dtype=np.int64)
+        else:
+            self._raw_index[:] = -1
+        self._raw_index[orders] = np.arange(n)
+
     def raw_index_of_order(self, order: int) -> int:
         """Raw (tombstones included) document index of an item — the
-        oracle's stand-in for the order->leaf SpaceIndex (`doc.rs:101-107`)."""
-        hits = np.nonzero(self.order[: self.n] == np.uint32(order))[0]
-        assert hits.size == 1, f"order {order} not found (or dup) in doc body"
-        return int(hits[0])
+        oracle's stand-in for the order->leaf SpaceIndex (`doc.rs:101-107`).
+        One indexed read off the splice-maintained map (``check()``
+        verifies the map against the body wholesale)."""
+        i = int(self._raw_index[order]) if order < len(self._raw_index) else -1
+        assert 0 <= i < self.n and int(self.order[i]) == order, (
+            f"order {order} not found in doc body"
+        )
+        return i
 
     def raw_index_of_live(self, content_pos: int) -> int:
         """Raw index of the ``content_pos``-th live item (0-based)."""
@@ -248,6 +271,16 @@ class ListCRDT:
         assert length > 0, "zero-length splice would corrupt neighbour origins"
         self._grow(length)
         n = self.n
+        # Index upkeep costs O(moved), the same as the splice itself:
+        # shifted items move +length, the new run maps to at..at+length.
+        if first_order + length > len(self._raw_index):
+            new = np.full(max(2 * len(self._raw_index),
+                              first_order + length), -1, dtype=np.int64)
+            new[: len(self._raw_index)] = self._raw_index
+            self._raw_index = new
+        self._raw_index[self.order[at:n].astype(np.int64)] += length
+        self._raw_index[first_order: first_order + length] = np.arange(
+            at, at + length)
         for name in ("order", "origin_left", "origin_right", "deleted", "chars"):
             arr = getattr(self, name)
             arr[at + length: n + length] = arr[at: n]
@@ -490,6 +523,9 @@ class ListCRDT:
         n = self.n
         orders = self.order[:n]
         assert len(np.unique(orders)) == n, "duplicate orders in doc body"
+        # The order->raw-index map must agree with the body everywhere.
+        assert bool((self._raw_index[orders.astype(np.int64)]
+                     == np.arange(n)).all()), "order index diverged from body"
         self.client_with_order.check()
         self.deletes.check()
         self.double_deletes.check()

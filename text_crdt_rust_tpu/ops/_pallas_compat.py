@@ -1,0 +1,13 @@
+"""jax/pallas version compatibility shared by every device engine.
+
+The kernels target the current pallas API (``pltpu.CompilerParams``);
+older jax releases (< 0.5) ship the same dataclass under the
+``TPUCompilerParams`` name.  Importing this module (``ops/__init__``
+does) aliases the new name onto the module object, which is shared by
+every ``from jax.experimental.pallas import tpu as pltpu`` site — no
+per-engine shims needed.
+"""
+from jax.experimental.pallas import tpu as _pltpu
+
+if not hasattr(_pltpu, "CompilerParams"):  # pragma: no cover - new jax
+    _pltpu.CompilerParams = _pltpu.TPUCompilerParams

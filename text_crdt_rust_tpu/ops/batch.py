@@ -533,6 +533,17 @@ def prefill_logs(doc, ops: OpTensors):
         rank_log=jnp.asarray(rank), chars_log=jnp.asarray(chars))
 
 
+def row_growth_bound(num_steps: int) -> int:
+    """Sound per-lane run-row bound after ``num_steps`` compiled device
+    steps: every step splices at most 2 new rows (insert splice / delete
+    boundary splits / remote-delete endpoint retires), so a stream of S
+    steps can never need more than ``1 + 2*S`` rows.  The growing
+    per-chunk capacities of the streaming configs (and the blocked-lanes
+    NB-per-chunk sizing) derive from this exact invariant — no sampling
+    (PERF.md §7.2/§9)."""
+    return 1 + 2 * num_steps
+
+
 # -- batching ----------------------------------------------------------------
 
 

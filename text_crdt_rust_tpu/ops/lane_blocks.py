@@ -1,0 +1,110 @@
+"""Per-lane K-row block machinery shared by the BLOCKED streaming
+engines (``rle_lanes`` / ``rle_lanes_mixed``).
+
+The un-blocked lanes engines pay a whole-``[CAP, B]`` plane pass (plus a
+log2(CAP) roll cumsum) on every step.  The blocked layout is ``ops.rle``'s
+structure carried into the per-lane world: runs live in K-row physical
+blocks, per-lane logical block tables (`mutations.rs:623-669`'s leaf
+locality) order them, and a step touches NB block sums plus ONE K-row
+block — O(NB + K) rows instead of O(CAP log CAP).
+
+The per-lane twist vs ``ops.rle``: every block index is a ``[1, B]``
+LANE VECTOR, not a scalar, so blocks cannot be addressed with a dynamic
+slice.  Gather/scatter instead run an NB-way select chain over static
+K-row slices — one plane-read equivalent — and every in-block pass
+(cumsum, splice, 3-way split) then costs K rows, which is where the
+win lives (K << CAP on the config-5/5r shapes).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.pallas import tpu as pltpu
+
+
+def vshift_up(x, amt, max_amt: int) -> jax.Array:
+    """Rows shifted toward LOWER indices by per-lane ``amt`` in
+    [0, max_amt] (the lane-vector twin of ``rle._shift_rows_up``):
+    out[j, b] = x[j + amt[0, b], b].  Binary decomposition: one static
+    roll per bit, selected per lane."""
+    n = x.shape[0]
+    out = x
+    for bit in range(max(max_amt, 1).bit_length()):
+        s = (1 << bit) % n
+        if s:
+            out = jnp.where((amt >> bit) & 1 != 0,
+                            pltpu.roll(out, n - s, axis=0), out)
+    return out
+
+
+def gather_block(plane_ref, b, K: int, NB: int) -> jax.Array:
+    """Per-lane block gather: ``out[j, lane] = plane[b[0,lane]*K + j,
+    lane]`` as one NB-way select chain over static K-row slices."""
+    ws = plane_ref[0:K, :]
+    for nb in range(1, NB):
+        ws = jnp.where(b == nb, plane_ref[nb * K:(nb + 1) * K, :], ws)
+    return ws
+
+
+def gather_head(plane_ref, b, K: int, NB: int) -> jax.Array:
+    """Row 0 of per-lane block ``b`` as a [1, B] vector."""
+    h = plane_ref[0:1, :]
+    for nb in range(1, NB):
+        h = jnp.where(b == nb, plane_ref[nb * K: nb * K + 1, :], h)
+    return h
+
+
+def scatter_block(plane_ref, b, ws, act, K: int, NB: int) -> None:
+    """Write ``ws`` back to per-lane block ``b`` on ``act`` lanes."""
+    for nb in range(NB):
+        cur = plane_ref[nb * K:(nb + 1) * K, :]
+        plane_ref[nb * K:(nb + 1) * K, :] = jnp.where(
+            act & (b == nb), ws, cur)
+
+
+def scatter_block2(plane_ref, b1, ws1, b2, ws2, act, K: int,
+                   NB: int) -> None:
+    """Two-block scatter (block split: keep-half to ``b1``, moved half
+    to the fresh block ``b2``; b1 != b2 per lane)."""
+    for nb in range(NB):
+        cur = plane_ref[nb * K:(nb + 1) * K, :]
+        v = jnp.where(act & (b1 == nb), ws1, cur)
+        plane_ref[nb * K:(nb + 1) * K, :] = jnp.where(
+            act & (b2 == nb), ws2, v)
+
+
+def lane_apply_partial(a, i_p, bo, bl, cs, ce, idx):
+    """Split run row ``i_p`` around its covered live sub-range
+    ``[cs, ce)`` into [head?] [tombstone mid] [tail?] (<= +2 rows), per
+    lane where ``a`` — the per-lane 3-way delete split shared by the
+    blocked kernels (the in-block twin of the whole-plane transform in
+    ``rle_lanes.do_delete`` / ``rle_lanes_mixed.apply_partial``).
+    ``idx`` is the row iota of the plane being edited."""
+    from .rle_lanes import _vrow, _vshift
+
+    o = _vrow(bo, i_p)
+    ln = _vrow(bl, i_p)
+    cs_i = _vrow(cs, i_p)
+    ce_i = _vrow(ce, i_p)
+    cov_i = ce_i - cs_i
+    has_head = (cs_i > 0) & a
+    has_tail = (ce_i < ln) & a
+    amt = has_head.astype(jnp.int32) + has_tail.astype(jnp.int32)
+    so = _vshift(bo, amt)
+    sl = _vshift(bl, amt)
+    no = jnp.where(idx <= i_p, bo, so)
+    nl = jnp.where(idx <= i_p, bl, sl)
+    p0o = jnp.where(has_head, o, -(o + cs_i))
+    p0l = jnp.where(has_head, cs_i, cov_i)
+    p1o = jnp.where(has_head, -(o + cs_i), o + ce_i)
+    p1l = jnp.where(has_head, cov_i, ln - ce_i)
+    w0 = a & (idx == i_p)
+    no = jnp.where(w0, p0o, no)
+    nl = jnp.where(w0, p0l, nl)
+    w1 = a & (idx == i_p + 1) & (amt >= 1)
+    no = jnp.where(w1, p1o, no)
+    nl = jnp.where(w1, p1l, nl)
+    w2 = a & (idx == i_p + 2) & (amt == 2)
+    no = jnp.where(w2, o + ce_i, no)
+    nl = jnp.where(w2, ln - ce_i, nl)
+    return no, nl, amt

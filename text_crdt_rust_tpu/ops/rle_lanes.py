@@ -454,8 +454,509 @@ def replay_lanes(ops: OpTensors, capacity: int, **kw) -> LanesResult:
     return make_replayer_lanes(ops, capacity, **kw)()
 
 
-def expand_lane(res: LanesResult, doc_index: int) -> np.ndarray:
-    """One lane's run rows -> per-char ±(order+1) column in doc order."""
+# ---------------------------------------------------------------------------
+# BLOCKED per-lane engine: ops.rle's K-row block structure carried into
+# the divergent-lanes world (ISSUE 2 tentpole).  Runs live in K-row
+# physical blocks; per-lane logical tables (blkord/rws/liv + the
+# incrementally-maintained inclusive prefix cumliv) order them; a step
+# descends over NB block sums and splices ONE gathered K-row block —
+# O(NB + K) touched rows instead of log2(CAP) rolls over [CAP, B].
+# Full blocks SPLIT into the logical order table (no global rebalance).
+# Bit-identical to the un-blocked kernel above: block splits move rows,
+# never runs, so the logical run sequence (and every emitted origin) is
+# the same at every step.
+# ---------------------------------------------------------------------------
+
+
+def _lanes_blocked_kernel(
+    pos_ref, dlen_ref, ilen_ref, start_ref,     # [CHUNK, B] VMEM op columns
+    ord0_ref, len0_ref, nlog0_ref,              # warm-start state inputs
+    blk0_ref, rws0_ref, liv0_ref,
+    ol_ref, or_ref,                             # [CHUNK, B] outputs
+    ordp, lenp, nlogv, blkord, rws, liv,        # state outputs (working)
+    err_ref,
+    cumliv,                                     # [NBT, B] scratch prefix
+    *, K: int, NB: int, NBT: int, CHUNK: int,
+):
+    from .lane_blocks import (
+        gather_block,
+        gather_head,
+        lane_apply_partial,
+        scatter_block,
+        scatter_block2,
+        vshift_up,
+    )
+
+    B = ordp.shape[1]
+    i = pl.program_id(1)
+    kdx = lax.broadcasted_iota(jnp.int32, (K, B), 0)
+    tidx = lax.broadcasted_iota(jnp.int32, (NBT, B), 0)
+    root_u = jnp.uint32(ROOT_ORDER)
+
+    ol_ref[:] = jnp.zeros_like(ol_ref)
+    or_ref[:] = jnp.zeros_like(or_ref)
+
+    @pl.when(i == 0)
+    def _init():
+        ordp[:] = ord0_ref[:]
+        lenp[:] = len0_ref[:]
+        # Fresh lanes hold one empty block in logical slot 0.
+        nlogv[:] = jnp.maximum(nlog0_ref[:], 1)
+        blkord[:] = blk0_ref[:]
+        rws[:] = rws0_ref[:]
+        liv[:] = liv0_ref[:]
+        cumliv[:] = _vcumsum(liv0_ref[:])
+        err_ref[:] = jnp.zeros_like(err_ref)
+
+    def trow(tbl, l):
+        """Per-lane slot read: ``tbl[l[0, b], b]`` as [1, B]."""
+        return jnp.sum(jnp.where(tidx == l, tbl[:], 0), axis=0,
+                       keepdims=True)
+
+    def slot_of_live_rank(rank1):
+        """Smallest logical slot whose cumulative live count reaches
+        ``rank1``, per lane (the `root.rs:54-88` descent over block
+        sums; slots >= nlog hold stale prefixes, masked out)."""
+        nl = nlogv[:]
+        hit = (cumliv[:] < rank1) & (tidx < nl)
+        return jnp.minimum(
+            jnp.sum(hit.astype(jnp.int32), axis=0, keepdims=True), nl - 1)
+
+    def live_before(l):
+        return trow(cumliv, l) - trow(liv, l)
+
+    def split(act, l):
+        """Per-lane leaf split (`mutations.rs:623-669`): move the top
+        half of slot ``l``'s rows to a fresh physical block spliced
+        into the logical order at ``l+1``.  Lanes at table capacity
+        raise the error flag and skip (a proceeding split would
+        overwrite a live block — the ops.rle advisor-r3 rule)."""
+        over = act & (nlogv[:] >= NB)
+
+        @pl.when(jnp.any(over))
+        def _cap():
+            err_ref[0:1, :] = jnp.where(over, 1, err_ref[0:1, :])
+
+        do = act & (nlogv[:] < NB)
+
+        @pl.when(jnp.any(do))
+        def _do():
+            b = trow(blkord, l)
+            r = trow(rws, l)
+            keep = r // 2
+            mv = r - keep
+            nbv = nlogv[:]  # per-lane fresh physical block id
+            ws_o = gather_block(ordp, b, K, NB)
+            ws_l = gather_block(lenp, b, K, NB)
+            liv_hi = jnp.sum(
+                jnp.where((kdx >= keep) & (kdx < r) & (ws_o > 0), ws_l,
+                          0), axis=0, keepdims=True)
+            up_o = vshift_up(ws_o, keep, K)
+            up_l = vshift_up(ws_l, keep, K)
+            scatter_block2(
+                ordp, b, jnp.where(kdx < keep, ws_o, 0),
+                nbv, jnp.where(kdx < mv, up_o, 0), do, K, NB)
+            scatter_block2(
+                lenp, b, jnp.where(kdx < keep, ws_l, 0),
+                nbv, jnp.where(kdx < mv, up_l, 0), do, K, NB)
+            # Logical tables: slots > l shift one down; cumliv shifts
+            # with them (slot l+1 inherits old c_l — its correct
+            # inclusive prefix after the split), slot l loses the
+            # moved-out top half.
+            for tbl in (blkord, rws, liv, cumliv):
+                sh = pltpu.roll(tbl[:], 1, axis=0)
+                tbl[:] = jnp.where(do & (tidx > l), sh, tbl[:])
+            w_l = do & (tidx == l)
+            w_l1 = do & (tidx == l + 1)
+            rws[:] = jnp.where(w_l, keep, jnp.where(w_l1, mv, rws[:]))
+            liv[:] = jnp.where(w_l, liv[:] - liv_hi,
+                               jnp.where(w_l1, liv_hi, liv[:]))
+            cumliv[:] = jnp.where(w_l, cumliv[:] - liv_hi, cumliv[:])
+            blkord[:] = jnp.where(w_l1, nbv, blkord[:])
+            nlogv[:] = nlogv[:] + do.astype(jnp.int32)
+
+    def find_insert_slot(p):
+        l = jnp.where(p == 0, 0, slot_of_live_rank(p))
+        return l, trow(rws, l)
+
+    def do_insert(k, act, p, il, st):
+        """Per-lane blocked insert: descend, gather ONE block, splice
+        <= 3 rows, scatter back (`mutations.rs:17-179`)."""
+        l, r0 = find_insert_slot(p)
+        need = act & (r0 + 2 > K)
+
+        @pl.when(jnp.any(need))
+        def _():
+            split(need, l)
+
+        # Re-descend only when a split actually moved slots (pure table
+        # reads, so the cond branch is Mosaic-safe).
+        l, r0 = lax.cond(jnp.any(need),
+                         lambda: find_insert_slot(p), lambda: (l, r0))
+        b = trow(blkord, l)
+        local = jnp.where(act, p - live_before(l), 0)
+        ws_o = gather_block(ordp, b, K, NB)
+        ws_l = gather_block(lenp, b, K, NB)
+        lv = jnp.where(ws_o > 0, ws_l, 0)
+        cum = _vcumsum(lv)
+        i_r = jnp.sum(((cum < local) & (kdx < r0)).astype(jnp.int32),
+                      axis=0, keepdims=True)
+        o_r = _vrow(ws_o, i_r)
+        l_r = _vrow(ws_l, i_r)
+        off = local - (_vrow(cum, i_r) - _vrow(lv, i_r))
+
+        left = jnp.where(p == 0, root_u,
+                         ((o_r - 1) + (off - 1)).astype(jnp.uint32))
+        mrg = act & (p > 0) & (off == l_r) & ((st + 1) == (o_r + l_r))
+        is_split = act & (p > 0) & (off < l_r)
+
+        # Raw successor (`doc.rs:452`): next row of this block, else the
+        # head row of the NEXT logical slot's block.
+        nxt_in_blk = _vrow(ws_o, i_r + 1)
+        b2 = trow(blkord, jnp.minimum(l + 1, NBT - 1))
+        nxt_slot_o = gather_head(ordp, b2, K, NB)
+        first_o = gather_head(ordp, trow(blkord, 0), K, NB)
+        succ_p0 = jnp.where(trow(rws, 0) > 0, first_o, 0)
+        succ_after = jnp.where(i_r + 1 < r0, nxt_in_blk,
+                               jnp.where(l + 1 < nlogv[:], nxt_slot_o, 0))
+        succ = jnp.where(p == 0, succ_p0,
+                         jnp.where(is_split, o_r + off, succ_after))
+        right = jnp.where(succ == 0, root_u,
+                          (jnp.abs(succ) - 1).astype(jnp.uint32))
+
+        ins_at = jnp.where(p == 0, 0, i_r + 1)
+        amt = jnp.where(jnp.logical_not(act) | mrg, 0,
+                        jnp.where(is_split, 2, 1))
+        so = _vshift(ws_o, amt)
+        sl = _vshift(ws_l, amt)
+        no = jnp.where(kdx < ins_at, ws_o, so)
+        nl = jnp.where(kdx < ins_at, ws_l, sl)
+        nl = jnp.where(is_split & (kdx == i_r), off, nl)
+        new_run = act & jnp.logical_not(mrg) & (kdx == ins_at)
+        no = jnp.where(new_run, st + 1, no)
+        nl = jnp.where(new_run, il, nl)
+        tail = is_split & (kdx == ins_at + 1)
+        no = jnp.where(tail, o_r + off, no)
+        nl = jnp.where(tail, l_r - off, nl)
+        nl = jnp.where(mrg & (kdx == i_r), l_r + il, nl)
+        scatter_block(ordp, b, no, act, K, NB)
+        scatter_block(lenp, b, nl, act, K, NB)
+        w_l = act & (tidx == l)
+        rws[:] = jnp.where(w_l, rws[:] + amt, rws[:])
+        liv[:] = jnp.where(w_l, liv[:] + il, liv[:])
+        cumliv[:] = jnp.where(act & (tidx >= l), cumliv[:] + il,
+                              cumliv[:])
+
+        ol_ref[pl.ds(k, 1), :] = jnp.where(act, left, 0)
+        or_ref[pl.ds(k, 1), :] = jnp.where(act, right, 0)
+
+    def do_delete(act, p, d):
+        """Per-lane blocked delete: per iteration each active lane
+        clears its target block's covered span (flip full covers, split
+        the <= 2 boundary runs); lanes advance block-to-block through
+        the incrementally updated prefix (`mutations.rs:520-570`)."""
+
+        def body(carry):
+            rem, iters = carry
+            a = act & (rem > 0)
+            l = slot_of_live_rank(p + 1)
+            need = a & (trow(rws, l) + 2 > K)
+
+            @pl.when(jnp.any(need))
+            def _():
+                split(need, l)
+
+            l = lax.cond(jnp.any(need),
+                         lambda: slot_of_live_rank(p + 1), lambda: l)
+            b = trow(blkord, l)
+            base = live_before(l)
+            ws_o = gather_block(ordp, b, K, NB)
+            ws_l = gather_block(lenp, b, K, NB)
+            lv = jnp.where(ws_o > 0, ws_l, 0)
+            cum = _vcumsum(lv)
+            before = base + cum - lv
+            remm = jnp.where(a, rem, 0)
+            cs = jnp.clip(p - before, 0, lv)
+            ce = jnp.clip(p + remm - before, 0, lv)
+            cov = ce - cs
+            tot = jnp.sum(cov, axis=0, keepdims=True)
+            full = (cov > 0) & (cov == ws_l)
+            part = (cov > 0) & jnp.logical_not(full)
+            npart = jnp.sum(part.astype(jnp.int32), axis=0,
+                            keepdims=True)
+            i1 = jnp.min(jnp.where(part, kdx, K), axis=0, keepdims=True)
+            i2 = jnp.max(jnp.where(part, kdx, -1), axis=0, keepdims=True)
+            ws_o = jnp.where(a & full, -ws_o, ws_o)
+            ws_o, ws_l, a2 = lane_apply_partial(
+                a & (npart >= 1), i2, ws_o, ws_l, cs, ce, kdx)
+            ws_o, ws_l, a1 = lane_apply_partial(
+                a & (npart == 2), i1, ws_o, ws_l, cs, ce, kdx)
+            scatter_block(ordp, b, ws_o, a, K, NB)
+            scatter_block(lenp, b, ws_l, a, K, NB)
+            w_l = a & (tidx == l)
+            rws[:] = jnp.where(w_l, rws[:] + a1 + a2, rws[:])
+            liv[:] = jnp.where(w_l, liv[:] - tot, liv[:])
+            cumliv[:] = jnp.where(a & (tidx >= l), cumliv[:] - tot,
+                                  cumliv[:])
+            return rem - jnp.where(a, tot, 0), iters + 1
+
+        # Each iteration clears one block's covered span per lane;
+        # > 2*NBT iterations without draining means some lane's delete
+        # ran off its document.
+        rem, _ = lax.while_loop(
+            lambda c: jnp.any(act & (c[0] > 0)) & (c[1] <= 2 * NBT),
+            body, (jnp.where(act, d, 0), 0))
+
+        @pl.when(jnp.any(act & (rem > 0)))
+        def _bad():
+            err_ref[1:2, :] = jnp.where(act & (rem > 0), 1,
+                                        err_ref[1:2, :])
+
+    def op_body(k, _):
+        p = pos_ref[pl.ds(k, 1), :]
+        d = dlen_ref[pl.ds(k, 1), :]
+        il = ilen_ref[pl.ds(k, 1), :]
+        st = start_ref[pl.ds(k, 1), :]
+
+        @pl.when(jnp.any(d > 0))
+        def _():
+            do_delete(d > 0, p, d)
+
+        @pl.when(jnp.any(il > 0))
+        def _():
+            do_insert(k, il > 0, p, il, st)
+
+        return 0
+
+    lax.fori_loop(0, CHUNK, op_body, 0)
+
+
+@dataclasses.dataclass
+class BlockedLanesResult:
+    """Device outputs of the BLOCKED per-lane engine: per-lane K-row
+    physical blocks + logical block tables."""
+
+    ordp: jax.Array     # i32[CAP, B]  physical K-row blocks
+    lenp: jax.Array     # i32[CAP, B]
+    nlog: jax.Array     # i32[1, B]    logical blocks in use per lane
+    blkord: jax.Array   # i32[NBT, B]  logical slot -> physical block
+    rws: jax.Array      # i32[NBT, B]  occupied rows per logical slot
+    liv: jax.Array      # i32[NBT, B]  live chars per logical slot
+    ol: jax.Array       # u32[S, B]
+    orr: jax.Array      # u32[S, B]
+    err: jax.Array      # i32[8, B]  0: out of blocks; 1: bad delete
+    batch: int
+    block_k: int
+
+    def check(self) -> None:
+        err = np.asarray(self.err)
+        if err[0].max() != 0:
+            raise RuntimeError(
+                f"blocked rle_lanes out of blocks on lanes "
+                f"{np.nonzero(err[0])[0][:8].tolist()}; raise capacity")
+        if err[1].max() != 0:
+            raise RuntimeError(
+                f"delete ran past the end of the document on lanes "
+                f"{np.nonzero(err[1])[0][:8].tolist()}")
+
+    def state(self):
+        """(ordp, lenp, nlog, blkord, rws, liv) — the next chunk's
+        ``init`` (stays on device; the warm-start chain)."""
+        return (self.ordp, self.lenp, self.nlog, self.blkord, self.rws,
+                self.liv)
+
+    @property
+    def rows(self):
+        """Total occupied rows per lane (compat with ``LanesResult``)."""
+        return jnp.sum(self.rws, axis=0, keepdims=True)
+
+
+@functools.lru_cache(maxsize=32)
+def _build_blocked_call(s_pad: int, B: int, capacity: int, block_k: int,
+                        chunk: int, interpret: bool,
+                        lane_tile: int | None = None):
+    """Shape-keyed cache for the blocked kernel (streaming chunks of one
+    geometry share one compiled kernel)."""
+    K = block_k
+    NB = capacity // K
+    NBT = max(8, NB)
+    T = lane_tile or _lane_tile(B)
+    _require(B % T == 0, f"lane_tile {T} must divide batch {B}")
+    col = lambda: pl.BlockSpec((chunk, T), lambda lb, i: (i, lb),
+                               memory_space=pltpu.VMEM)
+    whole = lambda rows: pl.BlockSpec(
+        (rows, T), lambda lb, i: (0, lb), memory_space=pltpu.VMEM)
+
+    call = pl.pallas_call(
+        partial(_lanes_blocked_kernel, K=K, NB=NB, NBT=NBT, CHUNK=chunk),
+        grid=(B // T, s_pad // chunk),
+        in_specs=[col(), col(), col(), col(),
+                  whole(capacity), whole(capacity), whole(1),
+                  whole(NBT), whole(NBT), whole(NBT)],
+        out_specs=[
+            col(), col(),
+            whole(capacity), whole(capacity), whole(1),
+            whole(NBT), whole(NBT), whole(NBT),
+            whole(8),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((s_pad, B), jnp.uint32),
+            jax.ShapeDtypeStruct((s_pad, B), jnp.uint32),
+            jax.ShapeDtypeStruct((capacity, B), jnp.int32),
+            jax.ShapeDtypeStruct((capacity, B), jnp.int32),
+            jax.ShapeDtypeStruct((1, B), jnp.int32),
+            jax.ShapeDtypeStruct((NBT, B), jnp.int32),
+            jax.ShapeDtypeStruct((NBT, B), jnp.int32),
+            jax.ShapeDtypeStruct((NBT, B), jnp.int32),
+            jax.ShapeDtypeStruct((8, B), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((NBT, T), jnp.int32),    # cumliv
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=110 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )
+    return jax.jit(lambda *a: call(*a))
+
+
+def make_replayer_lanes_blocked(
+    ops: OpTensors,
+    capacity: int,
+    block_k: int = 64,
+    chunk: int = 128,
+    init=None,
+    interpret: bool = False,
+    lane_tile: int | None = None,
+):
+    """Build a jitted BLOCKED per-lane replayer (``stack_ops`` streams,
+    local ops only) — bit-identical final state and per-op origins to
+    ``make_replayer_lanes``, at O(NB + K) touched rows per step.
+
+    ``capacity`` counts run rows per lane and must be a ``block_k``
+    multiple; growing per-chunk capacities grow NB at fixed K.  ``init``
+    is a prior ``BlockedLanesResult.state()`` 6-tuple.
+    """
+    kinds = np.asarray(ops.kind)
+    _require(kinds.ndim == 2, "rle_lanes takes stacked per-doc streams "
+             "([S, B] columns; see batch.stack_ops)")
+    _require(bool((kinds == KIND_LOCAL).all()),
+             "rle_lanes replays local streams; per-lane remote "
+             "streams -> ops.rle_lanes_mixed")
+    S, B = kinds.shape
+    _require(block_k >= 8, "block_k must hold a few runs")
+    _require(capacity % block_k == 0,
+             f"capacity ({capacity}) must be a multiple of block_k "
+             f"({block_k})")
+    s_pad = max(((S + chunk - 1) // chunk) * chunk, chunk)
+
+    def staged_col(get):
+        a = np.asarray(get(ops), dtype=np.int32)
+        return jnp.asarray(np.pad(a, ((0, s_pad - S), (0, 0))))
+
+    staged = (staged_col(lambda o: o.pos),
+              staged_col(lambda o: o.del_len),
+              staged_col(lambda o: o.ins_len),
+              staged_col(lambda o: o.ins_order_start))
+
+    NBT = max(8, capacity // block_k)
+    if init is None:
+        init = _empty_blocked_state(capacity, NBT, B)
+    else:
+        init = _grow_blocked_state(init, capacity, block_k, B)
+    jitted = _build_blocked_call(s_pad, B, capacity, block_k, chunk,
+                                 interpret, lane_tile)
+
+    def run(state=None) -> BlockedLanesResult:
+        ini = init if state is None else _grow_blocked_state(
+            state, capacity, block_k, B)
+        ol, orr, ordp, lenp, nlog, blk, rws, liv, err = jitted(
+            *staged, *ini)
+        return BlockedLanesResult(
+            ordp=ordp, lenp=lenp, nlog=nlog, blkord=blk, rws=rws,
+            liv=liv, ol=ol[:S], orr=orr[:S], err=err, batch=B,
+            block_k=block_k)
+
+    return run
+
+
+def _empty_blocked_state(capacity: int, NBT: int, B: int):
+    z = lambda r: jnp.zeros((r, B), jnp.int32)
+    return (z(capacity), z(capacity), z(1), z(NBT), z(NBT), z(NBT))
+
+
+def _grow_blocked_state(state, capacity: int, block_k: int, B: int):
+    """Pad a prior chunk's blocked 6-tuple up to this chunk's capacity:
+    fresh physical blocks append at the end (allocation order == block
+    id, so zero-padding is free), logical tables zero-pad past nlog."""
+    o0, l0, nlog, blk, rws, liv = state
+    o0 = jnp.asarray(o0, jnp.int32)
+    l0 = jnp.asarray(l0, jnp.int32)
+    _require(o0.shape[0] <= capacity and o0.shape[1] == B,
+             f"init state shape {o0.shape} incompatible with "
+             f"({capacity}, {B})")
+    _require(o0.shape[0] % block_k == 0,
+             f"prior capacity {o0.shape[0]} is not a block_k "
+             f"({block_k}) multiple — geometry K must not change "
+             "between chunks")
+    NBT = max(8, capacity // block_k)
+
+    def padp(a):
+        a = jnp.asarray(a, jnp.int32)
+        if a.shape[0] < capacity:
+            a = jnp.concatenate(
+                [a, jnp.zeros((capacity - a.shape[0], B), jnp.int32)],
+                axis=0)
+        return a
+
+    def padt(a):
+        a = jnp.asarray(a, jnp.int32)
+        _require(a.shape[0] <= NBT,
+                 f"table rows {a.shape[0]} exceed {NBT}")
+        if a.shape[0] < NBT:
+            a = jnp.concatenate(
+                [a, jnp.zeros((NBT - a.shape[0], B), jnp.int32)], axis=0)
+        return a
+
+    return (padp(o0), padp(l0),
+            jnp.asarray(nlog, jnp.int32).reshape(1, B),
+            padt(blk), padt(rws), padt(liv))
+
+
+def expand_lane_blocked(res, doc_index: int) -> np.ndarray:
+    """One lane of a blocked result -> per-char ±(order+1) column in doc
+    order (walk the logical block table)."""
+    res.check()
+    K = res.block_k
+    ordc = np.asarray(res.ordp[:, doc_index])
+    lenc = np.asarray(res.lenp[:, doc_index])
+    blk = np.asarray(res.blkord[:, doc_index])
+    rows = np.asarray(res.rws[:, doc_index])
+    nlog = int(np.asarray(res.nlog[0, doc_index]))
+    o_parts, l_parts = [], []
+    for l in range(nlog):
+        b, r = int(blk[l]), int(rows[l])
+        o_parts.append(ordc[b * K: b * K + r])
+        l_parts.append(lenc[b * K: b * K + r])
+    if not o_parts:
+        return np.zeros(0, np.int32)
+    o = np.concatenate(o_parts).astype(np.int64)
+    ln = np.concatenate(l_parts).astype(np.int64)
+    if len(o) == 0:
+        return np.zeros(0, np.int32)
+    assert (ln > 0).all(), "occupied run with non-positive length"
+    total = int(ln.sum())
+    base = np.repeat(np.abs(o), ln)
+    within = np.arange(total) - np.repeat(np.cumsum(ln) - ln, ln)
+    return (np.repeat(np.sign(o), ln) * (base + within)).astype(np.int32)
+
+
+def expand_lane(res, doc_index: int) -> np.ndarray:
+    """One lane's run rows -> per-char ±(order+1) column in doc order
+    (dispatches on the blocked-layout results too)."""
+    if hasattr(res, "blkord"):
+        return expand_lane_blocked(res, doc_index)
     res.check()
     r = int(np.asarray(res.rows)[0, doc_index])
     o = np.asarray(res.ordp)[:r, doc_index].astype(np.int64)

@@ -45,6 +45,7 @@ same ``RleResult`` / ``rle_to_flat`` result surface.
 """
 from __future__ import annotations
 
+import functools
 from functools import partial
 
 import jax
@@ -432,10 +433,17 @@ def _mixed_rle_kernel(
         new_run = (idx_k == ins_at) & jnp.logical_not(mrg)
         tail = is_split & (idx_k == ins_at + 1)
         # A split tail's origin-right is NOT the head's (merge-appended
-        # chars keep their own); -2 marks it unknowable -> any sibling
-        # classification of such a piece falls back to the serial walk.
+        # chars keep their own) — but the ``orl`` TABLE entry of the
+        # tail's head char (order ``tail_ol + 1``) is exact and
+        # immutable once written (every existing char's entry was
+        # prefilled or recorded at insert time), so read the TRUE value
+        # at split time (ADVICE r5 item 3).  The tail then re-qualifies
+        # for the ``integrate_fast`` sibling classification instead of
+        # poisoning the window with -2 and forcing the serial walk on
+        # every later op that scans past it.
+        t_or = tab_read(orl, jnp.clip(tail_ol + 1, 0, OT * LANES - 1))
         for ap, a, nv, tv in ((olp, ao, new_ol, tail_ol),
-                              (orp, ar, new_or, jnp.int32(-2)),
+                              (orp, ar, new_or, t_or),
                               (rkp, ak, new_rk, t_rk)):
             na = jnp.where(idx_k < ins_at, a, _shift_rows(a, amt, 2))
             na = jnp.where(new_run, nv, na)
@@ -932,7 +940,6 @@ def make_replayer_rle_mixed(
     _require(ops.lmax <= LANES, (
         f"insert chunks must be <= {LANES} chars for the order-table "
         f"window (compile with lmax<={LANES})"))
-    NBLp = max(8, NB)
 
     # By-order tables: everything the compiler knows (remote origins,
     # within-run chains, ranks), packed 128 orders/row, i32 (ROOT -> -1
@@ -963,6 +970,30 @@ def make_replayer_rle_mixed(
         ops.kind, ops.pos, ops.del_len, ops.del_target, ops.origin_left,
         ops.origin_right, ops.rank, ops.ins_len, ops.ins_order_start))
 
+    jitted = _build_mixed_call(s_pad, batch, capacity, block_k, chunk,
+                               OT, interpret, fast_integrate)
+    tables = (oll0, orl0, rkl0)
+
+    def run() -> RleMixedResult:
+        ol, orr, ordp, lenp, blk, rows, meta, err = jitted(*staged, *tables)
+        return RleMixedResult(
+            ordp=ordp, lenp=lenp, blkord=blk[0], rows=rows[0], meta=meta[0],
+            ol=ol[:s], orr=orr[:s], err=err,
+            block_k=block_k, num_blocks=NB, batch=batch)
+
+    return run
+
+
+@functools.lru_cache(maxsize=32)
+def _build_mixed_call(s_pad: int, batch: int, capacity: int,
+                      block_k: int, chunk: int, OT: int,
+                      interpret: bool, fast_integrate: bool):
+    """Shape-keyed cache: streams sharing one geometry share one traced
+    kernel (a per-call pallas_call re-traced on every replayer build —
+    the cost that capped the differential-fuzz drivers; the lanes
+    engines already cached theirs)."""
+    NB = capacity // block_k
+    NBLp = max(8, NB)
     smem = lambda: pl.BlockSpec(
         (chunk,), lambda i: (i,), memory_space=pltpu.SMEM)
 
@@ -1019,17 +1050,7 @@ def make_replayer_rle_mixed(
         ),
         interpret=interpret,
     )
-    jitted = jax.jit(lambda *a: call(*a))
-    tables = (oll0, orl0, rkl0)
-
-    def run() -> RleMixedResult:
-        ol, orr, ordp, lenp, blk, rows, meta, err = jitted(*staged, *tables)
-        return RleMixedResult(
-            ordp=ordp, lenp=lenp, blkord=blk[0], rows=rows[0], meta=meta[0],
-            ol=ol[:s], orr=orr[:s], err=err,
-            block_k=block_k, num_blocks=NB, batch=batch)
-
-    return run
+    return jax.jit(lambda *a: call(*a))
 
 
 def replay_mixed_rle(ops: OpTensors, capacity: int, **kw) -> RleMixedResult:

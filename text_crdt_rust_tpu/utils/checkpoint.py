@@ -193,6 +193,7 @@ def _rebuild_oracle(z, n: int, agents):
     doc.origin_right[:n] = z["origin_right"]
     doc.deleted[:n] = z["deleted"]
     doc.chars[:n] = z["chars"]
+    doc.rebuild_raw_index()  # the body was set directly, not spliced
     doc.frontier = [int(o) for o in z["frontier"]]
 
     doc.client_data = [ClientData(name) for name in agents]
