@@ -186,3 +186,73 @@ class TestFlatCheckpointIntegrity:
                 continue
             assert SA.to_string(back) == content, (
                 f"byte {off}: corrupted flat checkpoint loaded garbage")
+
+
+class TestCheckpointUnderConcurrentTraffic:
+    """ISSUE-3 satellite, at the utils/checkpoint + CausalBuffer level
+    (no serve/ machinery): a doc checkpointed mid-stream while peers
+    keep editing — their txns queue causally in a CausalBuffer — then
+    restored and drained, must be bit-identical to an always-resident
+    twin that applied the same stream without the round-trip."""
+
+    def test_evicted_midstream_restores_bit_identical(self, tmp_path):
+        from text_crdt_rust_tpu.models.sync import (
+            agent_watermarks,
+            export_txns_since,
+            state_digest,
+        )
+        from text_crdt_rust_tpu.parallel.causal import CausalBuffer
+
+        # Peer generates a delete-heavy stream, one txn chunk per edit.
+        rng = random.Random(5)
+        peer = ListCRDT()
+        pa = peer.get_or_create_agent_id("peer")
+        chunks, mark = [], 0
+        for i in range(24):
+            n = len(peer)
+            if n == 0 or rng.random() < 0.6:
+                peer.local_insert(pa, rng.randint(0, n), "ab")
+            else:
+                pos = rng.randint(0, n - 1)
+                peer.local_delete(pa, pos, min(2, n - pos))
+            chunks.append(export_txns_since(peer, mark))
+            mark = peer.get_next_order()
+
+        server = ListCRDT()
+        twin = ListCRDT()
+        buf = CausalBuffer()
+        p = str(tmp_path / "evicted.npz")
+
+        def deliver(doc, txns, buffer=None):
+            if buffer is None:
+                for t in txns:
+                    doc.apply_remote_txn(t)
+            else:
+                for t in buffer.add_all(txns):
+                    if doc is not None:
+                        doc.apply_remote_txn(t)
+
+        # First half applies live on both.
+        for chunk in chunks[:12]:
+            deliver(server, chunk, buf)
+            deliver(twin, chunk)
+        # Evict: serialize + drop; peers keep editing while out. The
+        # buffer keeps accepting (watermarks survive the round-trip) but
+        # releases accumulate unapplied.
+        save_doc(server, p)
+        server = None
+        queued = []
+        for chunk in chunks[12:]:
+            for t in buf.add_all(chunk):
+                queued.append(t)
+            deliver(twin, chunk)
+        assert queued, "nothing queued while evicted — test shape bug"
+        # Restore + replay the queued releases.
+        server = load_doc(p)
+        server.check()
+        deliver(server, queued)
+        assert server.to_string() == twin.to_string()
+        assert server.doc_spans() == twin.doc_spans()
+        assert state_digest(server) == state_digest(twin)
+        assert agent_watermarks(server) == agent_watermarks(twin)
+        assert buf.pending == 0

@@ -1,0 +1,88 @@
+"""serve/admission.py: typed backpressure — queue bounds, token
+buckets, frame rejection. Every refusal is an ``AdmissionError`` with a
+machine-readable reason and a counter; server state is untouched."""
+import pytest
+
+from text_crdt_rust_tpu.config import ServeConfig
+from text_crdt_rust_tpu.serve.admission import (
+    REASON_DOC_UNKNOWN,
+    REASON_FRAME_REJECTED,
+    REASON_QUEUE_FULL,
+    REASON_RATE_LIMITED,
+    AdmissionControl,
+    AdmissionError,
+    TokenBucket,
+)
+from text_crdt_rust_tpu.serve.server import DocServer
+
+
+def small_cfg(**kw) -> ServeConfig:
+    base = dict(num_shards=1, lanes_per_shard=2, lane_capacity=128,
+                order_capacity=256, step_buckets=(8, 32), max_txn_len=32)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def test_token_bucket_refills_on_logical_ticks():
+    b = TokenBucket(capacity=10, refill=2)
+    assert b.take(10, tick=0)          # full at birth
+    assert not b.take(1, tick=0)       # dry
+    assert not b.take(5, tick=1)       # one tick = 2 tokens
+    assert b.take(2, tick=1)
+    assert b.take(10, tick=100)        # refill caps at capacity
+
+
+def test_admission_reasons_and_counters():
+    ac = AdmissionControl(max_queue_per_doc=2, max_queue_global=3,
+                          max_txn_len=8)
+    ac.admit("d", "a", 4, doc_pending=0, tick=1)
+    with pytest.raises(AdmissionError) as e:
+        ac.admit("d", "a", 9, doc_pending=0, tick=1)
+    assert e.value.reason == REASON_FRAME_REJECTED
+    with pytest.raises(AdmissionError) as e:
+        ac.admit("d", "a", 1, doc_pending=2, tick=1)
+    assert e.value.reason == REASON_QUEUE_FULL
+    ac.enqueued(); ac.enqueued(); ac.enqueued()
+    with pytest.raises(AdmissionError) as e:
+        ac.admit("d2", "a", 1, doc_pending=0, tick=1)
+    assert e.value.reason == REASON_QUEUE_FULL
+    ac.dequeued(3)
+    ac.admit("d2", "a", 1, doc_pending=0, tick=1)
+    s = ac.counters.summary()
+    assert s["admitted"] == 2
+    assert s["rejected_frame_rejected"] == 1
+    assert s["rejected_queue_full"] == 2
+
+
+def test_rate_limit_is_per_agent():
+    ac = AdmissionControl(max_queue_per_doc=99, max_queue_global=99,
+                          max_txn_len=99, rate_capacity=4, rate_refill=0)
+    ac.admit("d", "hot", 4, doc_pending=0, tick=1)
+    with pytest.raises(AdmissionError) as e:
+        ac.admit("d", "hot", 1, doc_pending=0, tick=1)
+    assert e.value.reason == REASON_RATE_LIMITED
+    # A different agent is unaffected: one hot client cannot starve.
+    ac.admit("d", "cold", 4, doc_pending=0, tick=1)
+
+
+def test_server_rejects_unknown_doc_and_corrupt_frames():
+    srv = DocServer(small_cfg())
+    with pytest.raises(AdmissionError) as e:
+        srv.submit_frame("never-admitted", b"\xc7junk")
+    assert e.value.reason == REASON_DOC_UNKNOWN
+
+    srv.admit_doc("d")
+    with pytest.raises(AdmissionError) as e:
+        srv.submit_frame("d", b"\x00garbage frame")
+    assert e.value.reason == REASON_FRAME_REJECTED
+    assert srv.counters.get("rejected_frame_rejected") == 1
+    # The refusal left no queued state behind.
+    assert srv.doc_state("d").pending() == 0
+
+
+def test_server_rejects_oversize_local_edit():
+    srv = DocServer(small_cfg())
+    srv.admit_doc("d")
+    with pytest.raises(AdmissionError) as e:
+        srv.submit_local("d", "a", 0, ins_content="x" * 33)
+    assert e.value.reason == REASON_FRAME_REJECTED

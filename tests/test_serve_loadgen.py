@@ -1,0 +1,58 @@
+"""serve/loadgen.py: the closed-loop serving fuzz — Zipf traffic, fault
+injection, forced evictions — must end bit-identical everywhere.
+
+Tier-1 runs a compressed shape (fewer docs/ticks, lanes sized so
+eviction pressure is guaranteed); the ``slow`` tier runs the full
+ISSUE-3 acceptance shape (>=200 docs, >=3 agents/doc, >=20 evictions,
+10% per-class faults).
+"""
+import pytest
+
+from text_crdt_rust_tpu.config import ServeConfig
+from text_crdt_rust_tpu.serve.loadgen import ServeLoadGen
+
+
+def run_and_check(**kw):
+    gen = ServeLoadGen(**kw)
+    report = gen.run()
+    assert report["converged"], report["mismatches"]
+    return report
+
+
+def test_loadgen_converges_with_faults_and_evictions():
+    cfg = ServeConfig(num_shards=1, lanes_per_shard=6, lane_capacity=256,
+                      order_capacity=512)
+    report = run_and_check(
+        docs=24, agents_per_doc=3, ticks=14, events_per_tick=16,
+        zipf_alpha=1.1, fault_rate=0.10, local_prob=0.25, seed=11,
+        cfg=cfg)
+    srv = report["server"]
+    assert srv["evictions"] >= 5, "lane pressure too low to test eviction"
+    assert srv["restores"] >= 5
+    assert srv["rejected_frame_rejected"] > 0, "faults never injected?"
+    assert report["latency_us"]["samples"] > 0
+    assert 0 < srv["batch_fill_ratio_mean"] <= 1
+
+
+def test_loadgen_clean_channel_seeds_differ():
+    """No faults, different seed: still converges (the checker is not
+    fault-dependent) and rejects nothing at the codec layer."""
+    cfg = ServeConfig(num_shards=2, lanes_per_shard=4)
+    report = run_and_check(
+        docs=12, agents_per_doc=2, ticks=8, events_per_tick=10,
+        fault_rate=0.0, seed=23, cfg=cfg)
+    assert report["server"].get("rejected_frame_rejected", 0) == 0
+
+
+@pytest.mark.slow
+def test_loadgen_acceptance_shape():
+    """The ISSUE-3 acceptance criterion, verbatim: >=200 docs, >=3
+    agents/doc, Zipf popularity forcing >=20 evictions, 10% per-class
+    fault injection — every doc bit-identical to its host-oracle twin.
+    """
+    cfg = ServeConfig(num_shards=2, lanes_per_shard=16)
+    report = run_and_check(
+        docs=200, agents_per_doc=3, ticks=60, events_per_tick=48,
+        zipf_alpha=1.1, fault_rate=0.10, local_prob=0.25, seed=7,
+        cfg=cfg)
+    assert report["server"]["evictions"] >= 20

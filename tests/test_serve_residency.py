@@ -1,0 +1,148 @@
+"""serve/residency.py: the evict -> checkpoint -> restore lifecycle.
+
+The load-bearing invariant (ISSUE 3 satellite): a doc evicted
+mid-stream, edited-by-peers while out, restored, and drained is
+bit-identical to an always-resident twin that saw the same ops.
+"""
+import os
+
+import pytest
+
+from text_crdt_rust_tpu.config import ServeConfig
+from text_crdt_rust_tpu.models.oracle import ListCRDT
+from text_crdt_rust_tpu.models.sync import export_txns_since, state_digest
+from text_crdt_rust_tpu.net import codec
+from text_crdt_rust_tpu.serve.server import DocServer
+from text_crdt_rust_tpu.utils.checkpoint import CheckpointError
+
+
+def cfg(tmp_path, **kw):
+    base = dict(num_shards=1, lanes_per_shard=2, lane_capacity=256,
+                order_capacity=512, step_buckets=(8, 32), max_txn_len=32,
+                spool_dir=str(tmp_path))
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def peer_stream(n_txns, agent="amy"):
+    doc = ListCRDT()
+    a = doc.get_or_create_agent_id(agent)
+    mark = 0
+    chunks = []
+    for i in range(n_txns):
+        doc.local_insert(a, len(doc) // 2, f"<{i}>")
+        if i % 3 == 2 and len(doc) > 4:
+            doc.local_delete(a, 1, 2)
+        chunks.append(export_txns_since(doc, mark))
+        mark = doc.get_next_order()
+    return chunks, doc
+
+
+def test_evict_restore_while_peers_edit_matches_resident_twin(tmp_path):
+    """Evict mid-stream; peers keep editing while the doc is out (their
+    txns queue causally); a touch restores and replays; final state is
+    bit-identical (string AND digest AND device lane) to a twin server
+    that never evicted."""
+    chunks, src = peer_stream(8)
+    srv = DocServer(cfg(tmp_path, spool_dir=str(tmp_path / "a")))
+    twin = DocServer(cfg(tmp_path, spool_dir=str(tmp_path / "b")))
+    for s in (srv, twin):
+        s.admit_doc("d")
+
+    # First half applies on both; both lane-resident.
+    for chunk in chunks[:4]:
+        for t in chunk:
+            srv.submit_txn("d", t)
+            twin.submit_txn("d", t)
+        srv.tick(); twin.tick()
+    doc = srv.doc_state("d")
+    assert doc.in_lane
+
+    # Force the eviction mid-stream (the LRU path exercises the same
+    # call; forcing makes the window deterministic).
+    path = srv.residency.evict(doc)
+    assert os.path.exists(path) and doc.evicted and not doc.resident
+
+    # Peers edit while the doc is out: txns queue, nothing crashes.
+    for chunk in chunks[4:]:
+        for t in chunk:
+            srv.submit_txn("d", t)
+            twin.submit_txn("d", t)
+        twin.tick()
+    assert doc.evicted and len(doc.events) > 0
+
+    # The touch (queued events) restores at the next tick and replays.
+    srv.tick()
+    assert doc.resident and not doc.evicted
+    srv.drain(); twin.drain()
+
+    assert srv.counters.get("evictions") == 1
+    assert srv.counters.get("restores") == 1
+    assert srv.doc_string("d") == src.to_string()
+    assert srv.doc_string("d") == twin.doc_string("d")
+    assert (state_digest(doc.oracle)
+            == state_digest(twin.doc_state("d").oracle))
+    assert srv.verify_doc("d") and twin.verify_doc("d")
+
+
+def test_local_touch_restores_evicted_doc(tmp_path):
+    srv = DocServer(cfg(tmp_path))
+    srv.admit_doc("d")
+    srv.submit_local("d", "ed", 0, ins_content="hello")
+    srv.tick()
+    doc = srv.doc_state("d")
+    srv.residency.evict(doc)
+    # A local edit is a touch: restore + apply on the next tick.
+    srv.submit_local("d", "ed", 0, ins_content="ok ")
+    srv.tick()
+    assert srv.doc_string("d") == "ok hello"
+    assert srv.counters.get("restores") == 1
+
+
+def test_lru_evicts_coldest_lane_doc(tmp_path):
+    srv = DocServer(cfg(tmp_path, lanes_per_shard=2))
+    for i in range(3):
+        srv.admit_doc(f"d{i}")
+    srv.submit_local("d0", "e", 0, ins_content="a")
+    srv.tick()
+    srv.submit_local("d1", "e", 0, ins_content="b")
+    srv.tick()
+    # Both lanes held; d2's traffic must steal d0 (the coldest).
+    srv.submit_local("d2", "e", 0, ins_content="c")
+    srv.tick()
+    assert srv.doc_state("d2").in_lane
+    assert srv.doc_state("d0").evicted
+    assert srv.doc_state("d1").in_lane
+    # d0 comes back on touch, bit-identical.
+    srv.submit_local("d0", "e", 1, ins_content="z")
+    srv.tick()
+    assert srv.doc_string("d0") == "az"
+    for i in range(3):
+        assert srv.verify_doc(f"d{i}")
+
+
+def test_corrupt_checkpoint_refuses_to_restore(tmp_path):
+    srv = DocServer(cfg(tmp_path))
+    srv.admit_doc("d")
+    srv.submit_local("d", "e", 0, ins_content="precious")
+    srv.tick()
+    doc = srv.doc_state("d")
+    path = srv.residency.evict(doc)
+    raw = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(raw[:len(raw) // 2])  # truncated: must refuse whole
+    with pytest.raises(CheckpointError):
+        srv.residency.restore(doc)
+    assert doc.evicted  # refused whole: no partial state loaded
+
+
+def test_request_frames_deferred_while_evicted(tmp_path):
+    """A REQUEST for an evicted doc is a touch + a retry, not a crash."""
+    srv = DocServer(cfg(tmp_path))
+    srv.admit_doc("d")
+    srv.submit_local("d", "e", 0, ins_content="hi")
+    srv.tick()
+    srv.residency.evict(srv.doc_state("d"))
+    out = srv.submit_frame("d", codec.encode_request({"e": 0}))
+    assert out == []
+    assert srv.counters.get("requests_deferred_evicted") == 1

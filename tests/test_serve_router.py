@@ -1,0 +1,184 @@
+"""serve/router.py: frames -> per-doc causal queues; gap handling and
+REQUEST emission inherited from the PR 1 stack."""
+from text_crdt_rust_tpu.common import RemoteId, RemoteIns, RemoteTxn
+from text_crdt_rust_tpu.models.oracle import ListCRDT
+from text_crdt_rust_tpu.models.sync import (
+    agent_watermarks,
+    export_txns_since,
+    state_digest,
+)
+from text_crdt_rust_tpu.net import codec
+from text_crdt_rust_tpu.config import ServeConfig
+from text_crdt_rust_tpu.serve.server import DocServer
+
+ROOT = RemoteId("ROOT", 0xFFFFFFFF)
+
+
+def cfg(**kw):
+    base = dict(num_shards=2, lanes_per_shard=2, lane_capacity=128,
+                order_capacity=256, step_buckets=(8, 32), max_txn_len=32)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def peer_history(n=3):
+    """A small single-author history as ONE wire-ready txn per edit
+    (exported per edit — a whole-history export would RLE-merge the
+    linear spans into a single txn and defeat gap tests)."""
+    doc = ListCRDT()
+    a = doc.get_or_create_agent_id("amy")
+    out, mark = [], 0
+    for i in range(n):
+        doc.local_insert(a, i, chr(ord("a") + i))
+        out.extend(export_txns_since(doc, mark))
+        mark = doc.get_next_order()
+    assert len(out) == n
+    return out, doc
+
+
+def test_out_of_order_frames_buffer_then_release_in_order():
+    srv = DocServer(cfg())
+    srv.admit_doc("d")
+    txns, src = peer_history(3)
+    # Deliver txn 2 first: it must buffer (gap), not apply.
+    srv.submit_frame("d", codec.encode_txns(txns[2:3]))
+    doc = srv.doc_state("d")
+    assert doc.buffer.pending == 1 and not doc.events
+    # The server owes a REQUEST naming the gap.
+    req = srv.poll_request_frame("d")
+    kind, wants, _ = codec.decode_frame(req)
+    assert kind == codec.KIND_REQUEST and wants == {"amy": 0}
+    # Backfill arrives; everything releases, in causal order.
+    srv.submit_frame("d", codec.encode_txns(txns[0:2]))
+    assert doc.buffer.pending == 0 and len(doc.events) == 3
+    srv.tick()
+    assert srv.doc_string("d") == src.to_string()
+    assert srv.poll_request_frame("d") is None
+
+
+def test_duplicate_frames_dedup():
+    srv = DocServer(cfg())
+    srv.admit_doc("d")
+    txns, src = peer_history(2)
+    frame = codec.encode_txns(txns)
+    srv.submit_frame("d", frame)
+    srv.submit_frame("d", frame)   # exact duplicate delivery
+    srv.tick()
+    assert srv.doc_string("d") == src.to_string()
+    assert srv.doc_state("d").buffer.duplicates_dropped > 0
+
+
+def test_digest_reveals_fully_dropped_agent():
+    """An agent whose EVERY frame was lost is invisible to the causal
+    buffer; only the digest gossip can name the gap."""
+    srv = DocServer(cfg())
+    srv.admit_doc("d")
+    _, src = peer_history(3)
+    assert srv.poll_request_frame("d") is None
+    srv.submit_frame("d", codec.encode_digest(
+        agent_watermarks(src), state_digest(src)))
+    req = srv.poll_request_frame("d")
+    kind, wants, _ = codec.decode_frame(req)
+    assert wants == {"amy": 0}
+
+
+def test_request_frames_are_served_from_the_oracle():
+    srv = DocServer(cfg())
+    srv.admit_doc("d")
+    txns, src = peer_history(3)
+    srv.submit_frame("d", codec.encode_txns(txns))
+    srv.tick()
+    # A fresh replica asks for everything from seq 0.
+    out = srv.submit_frame("d", codec.encode_request({"amy": 0}))
+    assert out, "REQUEST not served"
+    replica = ListCRDT()
+    for frame in out:
+        kind, value, _ = codec.decode_frame(frame)
+        assert kind == codec.KIND_TXNS
+        for t in value:
+            replica.apply_remote_txn(t)
+    assert replica.to_string() == src.to_string()
+
+
+def test_shard_assignment_is_stable_and_balanced():
+    srv = DocServer(cfg(num_shards=2))
+    for i in range(8):
+        srv.admit_doc(f"d{i}")
+    shards = [srv.router.shard_lane(f"d{i}")[0] for i in range(8)]
+    assert sorted(set(shards)) == [0, 1]
+    assert abs(shards.count(0) - shards.count(1)) <= 1
+    # Stable across traffic.
+    srv.submit_local("d3", "e", 0, ins_content="hi")
+    srv.tick()
+    assert srv.router.shard_lane("d3")[0] == shards[3]
+
+
+def test_invalid_reference_txn_rejected_not_crash():
+    """A structurally-valid txn whose origin names a nonexistent item
+    must be dropped typed-and-counted, never an oracle assert."""
+    srv = DocServer(cfg())
+    srv.admit_doc("d")
+    bad = RemoteTxn(
+        id=RemoteId("mallory", 0), parents=[ROOT],
+        ops=[RemoteIns(RemoteId("ghost", 5), ROOT, "x")])
+    srv.submit_frame("d", codec.encode_txns([bad]))
+    srv.tick()
+    assert srv.counters.get("txns_rejected") == 1
+    assert srv.doc_string("d") == ""
+    # An honest txn still lands afterwards.
+    txns, src = peer_history(2)
+    srv.submit_frame("d", codec.encode_txns(txns))
+    srv.tick()
+    assert srv.doc_string("d") == src.to_string()
+
+
+def test_frame_admission_is_all_or_nothing():
+    """A mid-frame admission refusal must leave nothing enqueued
+    (two-phase check-then-ingest; the AdmissionError contract)."""
+    import pytest
+
+    from text_crdt_rust_tpu.serve.admission import AdmissionError
+
+    srv = DocServer(cfg(max_queue_per_doc=2))
+    srv.admit_doc("d")
+    txns, _ = peer_history(4)
+    with pytest.raises(AdmissionError) as e:
+        srv.submit_frame("d", codec.encode_txns(txns))  # 4 txns > bound 2
+    assert e.value.reason == "queue-full"
+    assert srv.doc_state("d").pending() == 0, "partial frame enqueued"
+    assert srv.counters.get("admitted") == 0
+
+
+def test_latency_stamped_at_admission_not_release():
+    """A txn held in the causal buffer keeps its ORIGINAL admission
+    stamp: the buffer wait is inside admission->applied latency."""
+    import time
+
+    srv = DocServer(cfg())
+    srv.admit_doc("d")
+    txns, _ = peer_history(2)
+    srv.submit_frame("d", codec.encode_txns(txns[1:2]))  # gap: buffers
+    t_blocked = time.perf_counter()
+    time.sleep(0.05)
+    srv.submit_frame("d", codec.encode_txns(txns[0:1]))  # releases both
+    doc = srv.doc_state("d")
+    assert len(doc.events) == 2
+    # txn 1 (second event, released by the backfill) was admitted BEFORE
+    # the sleep; its stamp must predate the backfill submission.
+    assert doc.events[1].t_submit <= t_blocked
+    assert doc.events[0].t_submit > t_blocked
+
+
+def test_rejected_events_do_not_count_as_applied():
+    """Rejected txns and invalid local edits are dequeued but feed
+    neither ops_applied nor the latency samples."""
+    srv = DocServer(cfg())
+    srv.admit_doc("d")
+    bad = RemoteTxn(
+        id=RemoteId("mallory", 0), parents=[ROOT],
+        ops=[RemoteIns(RemoteId("ghost", 5), ROOT, "xyz")])
+    srv.submit_txn("d", bad)
+    stats = srv.tick()
+    assert stats["ops_applied"] == 0 and stats["events_applied"] == 0
+    assert srv.batcher.latency_samples == []
+    assert srv.counters.get("txns_rejected") == 1

@@ -29,7 +29,14 @@ ENGINE_REGISTRY = {
     "blocked":         {"module": "ops.blocked", "configs": ("northstar",)},
     "blocked-mixed":   {"module": "ops.blocked_mixed", "configs": ("4",)},
     "hbm":             {"module": "ops.blocked_hbm", "configs": ("northstar",)},
-    "flat":            {"module": "ops.flat", "configs": ()},
+    # The serve batcher's device backend: the vmapped flat engine is the
+    # one whose incremental batched-apply surface (ops.flat.apply_ops_batch
+    # + per-lane upload/clear) the document server consumes today; the
+    # blocked lanes engines plug in behind the same LaneBackend interface
+    # once they grow per-tick staged-op application (serve/batcher.py).
+    "flat":            {"module": "ops.flat", "configs": ("serve",)},
+    # One huge doc sharded over the sp axis (bench --config sp).
+    "sp-apply":        {"module": "parallel.sp_apply", "configs": ("sp",)},
 }
 ENGINE_CHOICES = tuple(ENGINE_REGISTRY)
 
@@ -112,6 +119,44 @@ class StreamConfig:
 
     resync_every: int = 1      # chunks between host<->device resyncs
     checkpoint_dir: Optional[str] = None
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """The continuous-batching document server (`serve/`).
+
+    ``engine`` must be registered for the ``serve`` bench config in
+    ``ENGINE_REGISTRY`` (the batcher's device backend is built per
+    engine; ``serve/batcher.make_lane_backend`` validates and raises a
+    typed error for names without a serve backend).
+    """
+
+    engine: str = "flat"       # registry engine backing the lane batches
+    num_shards: int = 2        # device batches (one [B, CAP] doc batch each)
+    lanes_per_shard: int = 16  # B — docs resident per shard batch
+    lane_capacity: int = 512   # CAP — body rows per lane
+    order_capacity: int = 1536 # OCAP — by-order log rows per lane
+    lmax: int = 8              # insert-chunk width of compiled serve steps
+    step_buckets: tuple = (8, 32, 128)  # padded tick step shapes; a tick
+    #                            drains at most step_buckets[-1] compiled
+    #                            steps per doc so steady-state serving
+    #                            cycles a fixed kernel set (no recompiles)
+    max_queue_per_doc: int = 256    # admission: pending events per doc
+    max_queue_global: int = 8192    # admission: pending events total
+    max_txn_len: int = 128          # admission: items per submitted txn —
+    #                            must fit step_buckets[-1] so every
+    #                            admitted event can apply in one tick
+    #                            (DocServer asserts the pair at build)
+    rate_capacity: int = 0          # token bucket size per agent (0 = off)
+    rate_refill: int = 0            # tokens added per tick per agent
+    spool_dir: Optional[str] = None  # eviction checkpoint directory
+
+    def add_args(self, ap: argparse.ArgumentParser) -> None:
+        ap.add_argument("--serve-shards", type=int, default=self.num_shards)
+        ap.add_argument("--serve-lanes", type=int,
+                        default=self.lanes_per_shard)
+        ap.add_argument("--serve-capacity", type=int,
+                        default=self.lane_capacity)
 
 
 @dataclasses.dataclass

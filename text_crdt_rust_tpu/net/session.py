@@ -133,6 +133,108 @@ class DeviceMirror:
         counters.incr("device_txns_applied", len(txns))
 
 
+def span_is_items(doc: ListCRDT, agent_name: str, seq: int,
+                  span: int) -> bool:
+    """Every (agent, seq .. seq+span) names an existing document ITEM
+    — an inserted char, live or tombstoned — not a delete-op's
+    consumed seq (which maps to an order but to no body row).
+
+    An assigned order is an item iff it is not a delete-op order, so
+    after ``item_orders`` proves the seqs exist this is an O(log n)
+    interval-overlap test against the deletes log per chunk — no
+    body scan."""
+    aid = doc.get_agent_id(agent_name)
+    if aid is None or aid == CLIENT_INVALID:
+        return False
+    io = doc.client_data[aid].item_orders
+    del_log = doc.deletes
+    remaining, s = span, seq
+    while remaining > 0:
+        found = io.find(s)
+        if found is None:
+            return False
+        entry, off = found
+        take = min(entry.length - off, remaining)
+        o = entry.order + off
+        ok, idx = del_log.search(o)
+        if ok:
+            return False  # chunk starts inside a delete-op range
+        ents = del_log.entries
+        if idx < len(ents) and ents[idx].key < o + take:
+            return False  # a delete-op range starts inside the chunk
+        s += take
+        remaining -= take
+    return True
+
+
+def txn_refs_known(doc: ListCRDT, txn: RemoteTxn) -> bool:
+    """Every id a released txn references must resolve at apply time.
+    The causal buffer only checks *parents*; a well-formed frame from
+    a buggy or malicious peer can still be out of order (after an
+    earlier same-agent rejection rolled the watermark back), or
+    reference unknown origins, forward/self seqs, or delete-op seqs —
+    all of which the oracle hard-asserts on. Callers (the resync
+    session's pump loop, the serve batcher's tick) reject
+    typed-and-counted instead of crashing.
+
+    Three tiers of reference:
+    - the txn itself must be seq-in-order against the DOC watermark;
+    - parents are txn ids: they need a seq->order *mapping*
+      (seq < watermark) but not a body row (a txn's last op may be a
+      delete op);
+    - origins and delete targets must name *items*: validated against
+      the document body for known history, or against the
+      inserted-char intervals of STRICTLY EARLIER ops of this txn."""
+    marks = agent_watermarks(doc)
+    if txn.id.seq != marks.get(txn.id.agent, 0):
+        return False
+    own_ins: List = []  # (start, end) insert seq intervals so far
+
+    def parent_known(rid) -> bool:
+        if rid.agent == "ROOT":
+            return True
+        return rid.seq < marks.get(rid.agent, 0)
+
+    def item_known(rid, span=1) -> bool:
+        if rid.agent == "ROOT":
+            return True
+        end = rid.seq + span
+        cur = rid.seq
+        wm = marks.get(rid.agent, 0)
+        if cur < wm:
+            lo = min(end, wm) - cur
+            if not span_is_items(doc, rid.agent, cur, lo):
+                return False
+            cur += lo
+        if rid.agent != txn.id.agent:
+            return cur >= end
+        # Remainder must be chars this txn already inserted
+        # (intervals ascend and are disjoint by construction).
+        for s, e in own_ins:
+            if cur >= end:
+                break
+            if s <= cur < e:
+                cur = min(e, end)
+        return cur >= end
+
+    if not all(parent_known(p) for p in txn.parents):
+        return False
+    cursor = txn.id.seq
+    for op in txn.ops:
+        if isinstance(op, RemoteIns):
+            if not (item_known(op.origin_left)
+                    and item_known(op.origin_right)):
+                return False
+            nxt = cursor + len(op.ins_content)
+            own_ins.append((cursor, nxt))
+            cursor = nxt
+        else:
+            if not item_known(op.id, op.len):
+                return False
+            cursor += op.len
+    return True
+
+
 class ResyncSession:
     """One peer endpoint of the resync protocol.
 
@@ -173,108 +275,10 @@ class ResyncSession:
 
     # -- internals ----------------------------------------------------------
 
-    def _span_is_items(self, agent_name: str, seq: int, span: int) -> bool:
-        """Every (agent, seq .. seq+span) names an existing document ITEM
-        — an inserted char, live or tombstoned — not a delete-op's
-        consumed seq (which maps to an order but to no body row).
-
-        An assigned order is an item iff it is not a delete-op order, so
-        after ``item_orders`` proves the seqs exist this is an O(log n)
-        interval-overlap test against the deletes log per chunk — no
-        body scan."""
-        aid = self.doc.get_agent_id(agent_name)
-        if aid is None or aid == CLIENT_INVALID:
-            return False
-        io = self.doc.client_data[aid].item_orders
-        del_log = self.doc.deletes
-        remaining, s = span, seq
-        while remaining > 0:
-            found = io.find(s)
-            if found is None:
-                return False
-            entry, off = found
-            take = min(entry.length - off, remaining)
-            o = entry.order + off
-            ok, idx = del_log.search(o)
-            if ok:
-                return False  # chunk starts inside a delete-op range
-            ents = del_log.entries
-            if idx < len(ents) and ents[idx].key < o + take:
-                return False  # a delete-op range starts inside the chunk
-            s += take
-            remaining -= take
-        return True
-
-    def _txn_refs_known(self, txn: RemoteTxn) -> bool:
-        """Every id a released txn references must resolve at apply time.
-        The causal buffer only checks *parents*; a well-formed frame from
-        a buggy or malicious peer can still be out of order (after an
-        earlier same-agent rejection rolled the watermark back), or
-        reference unknown origins, forward/self seqs, or delete-op seqs —
-        all of which the oracle hard-asserts on. Reject typed-and-counted
-        instead of crashing the pump loop.
-
-        Three tiers of reference:
-        - the txn itself must be seq-in-order against the DOC watermark;
-        - parents are txn ids: they need a seq->order *mapping*
-          (seq < watermark) but not a body row (a txn's last op may be a
-          delete op);
-        - origins and delete targets must name *items*: validated against
-          the document body for known history, or against the
-          inserted-char intervals of STRICTLY EARLIER ops of this txn."""
-        marks = agent_watermarks(self.doc)
-        if txn.id.seq != marks.get(txn.id.agent, 0):
-            return False
-        own_ins: List = []  # (start, end) insert seq intervals so far
-
-        def parent_known(rid) -> bool:
-            if rid.agent == "ROOT":
-                return True
-            return rid.seq < marks.get(rid.agent, 0)
-
-        def item_known(rid, span=1) -> bool:
-            if rid.agent == "ROOT":
-                return True
-            end = rid.seq + span
-            cur = rid.seq
-            wm = marks.get(rid.agent, 0)
-            if cur < wm:
-                lo = min(end, wm) - cur
-                if not self._span_is_items(rid.agent, cur, lo):
-                    return False
-                cur += lo
-            if rid.agent != txn.id.agent:
-                return cur >= end
-            # Remainder must be chars this txn already inserted
-            # (intervals ascend and are disjoint by construction).
-            for s, e in own_ins:
-                if cur >= end:
-                    break
-                if s <= cur < e:
-                    cur = min(e, end)
-            return cur >= end
-
-        if not all(parent_known(p) for p in txn.parents):
-            return False
-        cursor = txn.id.seq
-        for op in txn.ops:
-            if isinstance(op, RemoteIns):
-                if not (item_known(op.origin_left)
-                        and item_known(op.origin_right)):
-                    return False
-                nxt = cursor + len(op.ins_content)
-                own_ins.append((cursor, nxt))
-                cursor = nxt
-            else:
-                if not item_known(op.id, op.len):
-                    return False
-                cursor += op.len
-        return True
-
     def _apply_released(self, released: List[RemoteTxn]) -> None:
         applied = []
         for txn in released:
-            if not self._txn_refs_known(txn):
+            if not txn_refs_known(self.doc, txn):
                 self.counters.incr("txns_rejected")
                 self.protocol_error = True
                 # The release advanced the buffer watermark; undo it so

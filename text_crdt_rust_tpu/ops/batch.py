@@ -160,6 +160,27 @@ class OrderAssigner:
         ]
         self.next_order = 0
 
+    @classmethod
+    def from_oracle(cls, doc, table: "AgentTable") -> "OrderAssigner":
+        """Rebuild the compiler's order metadata from a live (or
+        checkpoint-restored) oracle document, so compilation can resume
+        mid-history — the serve layer's restore path
+        (`serve/residency.py`): a doc evicted to a checkpoint loses its
+        in-memory assigner, and the restored oracle's per-agent
+        ``item_orders`` are exactly the state to resume from.
+
+        ``table`` must list the oracle's agents in dense-id order (the
+        checkpoint meta's ``agents`` list) so agent ids align."""
+        assert table.names == [cd.name for cd in doc.client_data], (
+            "agent table order must match the oracle's dense agent ids")
+        out = cls(table)
+        for aid, cd in enumerate(doc.client_data):
+            io = out._orders_of(aid)
+            for e in cd.item_orders:
+                io.append(KOrderSpan(e.seq, e.order, e.length))
+        out.next_order = doc.get_next_order()
+        return out
+
     def _orders_of(self, agent_id: int) -> Rle:
         while agent_id >= len(self.item_orders):
             self.item_orders.append(Rle())
@@ -560,6 +581,30 @@ def pad_ops(ops: OpTensors, num_steps: int) -> OpTensors:
         return np.pad(np.asarray(a), width)
 
     return jax.tree.map(pad, ops)
+
+
+def empty_ops(lmax: int) -> OpTensors:
+    """A zero-step stream (idle lanes in a serve batch tick)."""
+    return _Rows(lmax).to_tensors()
+
+
+def concat_ops(streams: Sequence[OpTensors]) -> OpTensors:
+    """Concatenate step streams along the step axis (equal lmax).
+
+    The serve batcher compiles one stream per drained event and fuses
+    them into the doc's tick stream; orders were threaded through one
+    assigner, so plain concatenation preserves the compiled invariants.
+    """
+    streams = [s for s in streams if s.num_steps > 0]
+    if not streams:
+        return empty_ops(1)
+    lmax = streams[0].lmax
+    assert all(s.lmax == lmax for s in streams), "mixed lmax streams"
+    if len(streams) == 1:
+        return streams[0]
+    return jax.tree.map(
+        lambda *xs: np.concatenate([np.asarray(x) for x in xs], axis=0),
+        *streams)
 
 
 def stack_ops(streams: Sequence[OpTensors]) -> OpTensors:

@@ -238,19 +238,24 @@ def step(doc: FlatDoc, op, local_only: bool = False) -> FlatDoc:
 
 def _check_capacity(doc: FlatDoc, ops: OpTensors) -> None:
     """Host-side overflow guard: the splice wraps around silently on
-    device, so exceeding the static capacities would corrupt, not crash."""
-    need = np.asarray(doc.n).max() + np.asarray(ops.ins_len).sum(axis=0).max()
-    assert need <= doc.capacity, (
-        f"op stream needs {int(need)} rows but capacity is {doc.capacity}; "
-        f"allocate a larger FlatDoc"
+    device, so exceeding the static capacities would corrupt, not crash.
+
+    The bound is per-document: with a batched doc and per-lane streams
+    (the serve batcher's shape) each lane's own occupancy pairs with its
+    own stream's growth — a full lane with no traffic must not fail the
+    check on behalf of an empty lane with a long stream."""
+    need = np.asarray(doc.n) + np.asarray(ops.ins_len).sum(axis=0)
+    assert int(np.max(need)) <= doc.capacity, (
+        f"op stream needs {int(np.max(need))} rows but capacity is "
+        f"{doc.capacity}; allocate a larger FlatDoc"
     )
-    o_need = (np.asarray(doc.next_order).max()
-              + np.asarray(ops.order_advance).sum(axis=0).max())
+    o_need = (np.asarray(doc.next_order)
+              + np.asarray(ops.order_advance).sum(axis=0))
     # lmax slots of headroom: the log-write window is a static lmax-wide
     # slice whose clipped start must never shift a real write.
-    assert o_need <= doc.order_capacity - ops.lmax, (
-        f"op stream needs {int(o_need)}+{ops.lmax} orders but order "
-        f"capacity is {doc.order_capacity}; allocate a larger FlatDoc"
+    assert int(np.max(o_need)) <= doc.order_capacity - ops.lmax, (
+        f"op stream needs {int(np.max(o_need))}+{ops.lmax} orders but "
+        f"order capacity is {doc.order_capacity}; allocate a larger FlatDoc"
     )
 
 

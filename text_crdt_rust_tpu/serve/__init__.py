@@ -1,0 +1,22 @@
+"""serve/ — the continuous-batching document server (ISSUE 3).
+
+Turns the library (engines, wire codec, causal buffering, checkpoints)
+into a single-process server that multiplexes thousands of live
+documents onto B-lane device batches:
+
+- ``admission``  — typed backpressure (bounded queues, token buckets);
+- ``router``     — doc_id -> (shard, lane) + frames -> causal queues;
+- ``batcher``    — per-tick drain -> bucketed [S, B] device pass;
+- ``residency``  — LRU lanes, checkpoint evict / restore;
+- ``server``     — the ``DocServer`` facade;
+- ``loadgen``    — deterministic closed-loop load generator + checker.
+"""
+from .admission import (  # noqa: F401
+    AdmissionControl,
+    AdmissionError,
+    TokenBucket,
+)
+from .batcher import ContinuousBatcher, make_lane_backend  # noqa: F401
+from .residency import LaneResidency  # noqa: F401
+from .router import DocState, ShardRouter  # noqa: F401
+from .server import DocServer  # noqa: F401

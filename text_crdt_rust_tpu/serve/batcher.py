@@ -1,0 +1,423 @@
+"""The continuous batcher: per-doc causal queues -> one device step/tick.
+
+Each tick the batcher drains causally-ready events across every
+lane-resident document of a shard, applies them to the per-doc host
+oracles (the source of truth), compiles them into the fixed-shape
+columnar op tensors ``ops/batch.py`` defines, stacks them time-major
+``[S, B]`` across the shard's B lanes, and applies the whole shard in
+ONE vmapped device pass of the registry-selected lane engine — the
+continuous-batching shape of LLM inference serving (ragged requests
+coalesced into fixed-shape device steps), with YATA's delivery-order
+freedom (PAPERS.md, Nicolaescu et al.) guaranteeing that any causally
+valid drain order converges bit-identically.
+
+Fixed shapes are what keep steady-state serving compile-free: tick step
+counts are padded up to a small static set of **step buckets** (the
+`perf/fuzz_mixed_fast.py` shape-bucketing idea), the lane count B and
+per-lane capacities are static, and the device call always runs the
+``local_only=False`` kernel variant — so after the buckets are warm the
+server cycles a fixed set of compiled programs (asserted by
+``tests/test_serve_batcher.py`` via ``LaneBackend.shapes_seen``).
+
+Per-event cost is bounded before compilation (``estimate_steps`` walks
+the same run boundaries the compiler will) so one oversized edit can
+never blow the tick's bucket; admission's ``max_txn_len`` makes the
+bound a protocol guarantee. Capacity overflow inside a lane *degrades
+the doc to the host oracle* (lane freed, truth preserved) the way
+`net/session.py`'s ``DeviceMirror`` does — never an assert on the
+serving path.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..common import LocalOp, RemoteDel, RemoteIns, RemoteTxn
+from ..net.session import txn_refs_known
+from ..models.sync import agent_watermarks
+from ..ops import batch as B
+from ..ops import flat as F
+from ..ops import span_arrays as SA
+from ..utils.metrics import Counters
+from ..utils.testdata import TestPatch
+from .router import EV_LOCAL, DocState, Event, ShardRouter
+
+
+class FlatLaneBackend:
+    """The flat engine (`ops/flat.py`) as a serve lane backend: one
+    batched ``FlatDoc`` ``[B, CAP]`` per shard, applied with the vmapped
+    step under ``lax.scan`` — the north-star kernel shape, incremental
+    per tick.
+
+    Surface the batcher/residency layers consume (any future blocked
+    lanes backend implements the same):
+
+    - ``apply(stacked)``    — one device pass for a ``[S, B]`` tick;
+    - ``clear_lane(b)`` / ``upload_lane(b, oracle, ranks)`` — residency
+      writes (restore re-seeds a lane from the restored oracle);
+    - ``remap_lane_ranks(b, mapping)`` — agent-onboarding epoch re-base
+      (`ops.batch.rank_remap`) for one lane;
+    - ``lane_signed(b)`` / ``fits(...)`` — readback + capacity probe.
+    """
+
+    engine = "flat"
+
+    def __init__(self, lanes: int, capacity: int, order_capacity: int,
+                 lmax: int):
+        import jax.numpy as jnp
+
+        self.lanes = lanes
+        self.capacity = capacity
+        self.order_capacity = order_capacity
+        self.lmax = lmax
+        base = SA.make_flat_doc(capacity, order_capacity)
+        # Materialize the broadcast so lane writes (.at[b].set) behave
+        # like independent columns from the start.
+        self.docs = jax.tree.map(jnp.array, SA.stack_docs(base, lanes))
+        self._empty = base
+        self.shapes_seen: set = set()   # compiled (S,) tick shapes
+
+    def fits(self, n: int, next_order: int) -> bool:
+        """Would a doc of ``n`` rows / ``next_order`` orders fit a lane
+        (with the engine's lmax log-write headroom)?"""
+        return (n <= self.capacity
+                and next_order <= self.order_capacity - self.lmax)
+
+    def clear_lane(self, b: int) -> None:
+        self.docs = jax.tree.map(
+            lambda batched, one: batched.at[b].set(one),
+            self.docs, self._empty)
+
+    def upload_lane(self, b: int, oracle, rank_of_agent) -> None:
+        flat = SA.upload_oracle(oracle, self.capacity, rank_of_agent,
+                                self.order_capacity)
+        self.docs = jax.tree.map(
+            lambda batched, one: batched.at[b].set(one), self.docs, flat)
+
+    def remap_lane_ranks(self, b: int, mapping: np.ndarray) -> None:
+        import dataclasses
+
+        import jax.numpy as jnp
+
+        m = jnp.asarray(np.asarray(mapping, dtype=np.uint32))
+        lane = self.docs.rank_log[b]
+        safe = jnp.minimum(lane, m.shape[0] - 1).astype(jnp.int32)
+        new = jnp.where(lane < m.shape[0], m[safe], lane)
+        self.docs = dataclasses.replace(
+            self.docs, rank_log=self.docs.rank_log.at[b].set(new))
+
+    def apply(self, stacked: B.OpTensors) -> None:
+        """One [S, B] tick: prefill the by-order logs host-side, then a
+        single jitted vmapped scan. Always the full (local+remote)
+        kernel variant so the tick mix can't flip compiled programs."""
+        F._check_capacity(self.docs, stacked)
+        docs = B.prefill_logs(self.docs, stacked)
+        self.shapes_seen.add(int(stacked.num_steps))
+        self.docs = F._apply_ops_batch(docs, stacked, local_only=False)
+
+    def barrier(self) -> None:
+        np.asarray(self.docs.n)
+
+    def lane_doc(self, b: int):
+        return jax.tree.map(lambda x: x[b], self.docs)
+
+    def lane_signed(self, b: int) -> np.ndarray:
+        """±(order+1) body column of lane ``b`` (occupied rows only)."""
+        lane = self.lane_doc(b)
+        n = int(lane.n)
+        return np.asarray(lane.signed)[:n]
+
+    def lane_to_string(self, b: int) -> str:
+        return SA.to_string(self.lane_doc(b))
+
+
+def make_lane_backend(engine: str, *, lanes: int, capacity: int,
+                      order_capacity: int, lmax: int):
+    """Registry-validated lane-backend construction. ``engine`` must be
+    registered for the ``serve`` config in ``config.ENGINE_REGISTRY``;
+    unknown or serve-less engines raise a precise ``ValueError`` at
+    construction time (config-time strictness — runtime failures
+    degrade, construction failures explain)."""
+    from ..config import ENGINE_REGISTRY, engines_for
+
+    serve_engines = engines_for("serve")
+    if engine not in ENGINE_REGISTRY:
+        raise ValueError(
+            f"unknown engine {engine!r} (registry: "
+            f"{tuple(ENGINE_REGISTRY)})")
+    if engine not in serve_engines:
+        raise ValueError(
+            f"engine {engine!r} has no serve lane backend; registered "
+            f"serve engines: {serve_engines}")
+    assert engine == "flat", engine
+    return FlatLaneBackend(lanes, capacity, order_capacity, lmax)
+
+
+def oracle_signed(oracle) -> np.ndarray:
+    """The oracle body as the device's ±(order+1) encoding — the
+    bit-identity comparison target for a lane."""
+    n = oracle.n
+    order = oracle.order[:n].astype(np.int64)
+    sign = np.where(oracle.deleted[:n], -1, 1)
+    return (sign * (order + 1)).astype(np.int32)
+
+
+def estimate_steps(doc: DocState, event: Event, lmax: int) -> int:
+    """Compiled step count of ``event`` against the doc's CURRENT
+    assigner state (events estimate in FIFO order, so every earlier
+    event's orders are already assigned). Mirrors the compiler's
+    chunking: insert runs split at ``lmax``; remote delete targets split
+    at the target agent's order-run boundaries (``dmax=None``).
+
+    A delete targeting this txn's OWN fresh inserts (seqs at or past the
+    agent's watermark) costs one step: the compiler allocates the whole
+    txn as one contiguous order span before walking its ops. An unknown
+    target agent costs one step too — that txn fails the apply-time
+    reference validation and is dropped without compiling."""
+    if event.kind == EV_LOCAL:
+        _agent, _pos, _del_len, ins = event.payload
+        return max(1, -(-len(ins) // lmax))
+    steps = 0
+    txn: RemoteTxn = event.payload
+    for op in txn.ops:
+        if isinstance(op, RemoteIns):
+            steps += -(-len(op.ins_content) // lmax)
+        else:
+            assert isinstance(op, RemoteDel)
+            if op.id.agent not in doc.table:
+                steps += 1  # rejected at apply (refs unknown)
+                continue
+            aid = doc.table.id_of(op.id.agent)
+            known = doc.assigner.next_seq(aid)
+            end = op.id.seq + op.len
+            if op.id.seq >= known:
+                steps += 1  # entirely in-txn fresh range: one span
+                continue
+            steps += len(doc.assigner.target_runs(
+                aid, op.id.seq, min(end, known) - op.id.seq))
+            if end > known:
+                steps += 1  # tail lands in the txn's own fresh span
+    return max(steps, 1)
+
+
+class ContinuousBatcher:
+    """Drains per-doc event queues into one bucketed device pass per
+    shard per tick. Owns nothing long-lived but the backends' jit
+    caches; doc state lives in the router, lane ownership in residency.
+    """
+
+    def __init__(self, router: ShardRouter, residency, *,
+                 step_buckets: Tuple[int, ...], lmax: int,
+                 counters: Optional[Counters] = None):
+        assert tuple(sorted(step_buckets)) == tuple(step_buckets)
+        self.router = router
+        self.residency = residency
+        self.step_buckets = tuple(step_buckets)
+        self.lmax = lmax
+        self.counters = counters if counters is not None else Counters()
+        self.latency_samples: List[float] = []
+
+    def bucket(self, steps: int) -> int:
+        for b in self.step_buckets:
+            if steps <= b:
+                return b
+        raise AssertionError(
+            f"tick stream of {steps} steps exceeds the largest bucket "
+            f"{self.step_buckets[-1]} (drain budget bug)")
+
+    # -- per-event processing ----------------------------------------------
+
+    def _grow_table(self, doc: DocState, names) -> None:
+        """Register new agent names; if the doc holds a lane, re-base its
+        persisted rank log through the old->new rank map (the epoch
+        boundary of ``ops.batch.rank_remap`` — mid-stream onboarding)."""
+        new = [n for n in names if n != "ROOT" and n not in doc.table]
+        if not new:
+            return
+        old_names = list(doc.table.names)
+        for n in new:
+            doc.table.add(n)
+        if doc.in_lane and old_names:
+            mapping = B.rank_remap(old_names, doc.table)
+            backend = self.residency.backends[doc.shard]
+            backend.remap_lane_ranks(doc.lane, mapping)
+            self.counters.incr("lane_rank_remaps")
+
+    def _apply_local(self, doc: DocState, event: Event,
+                     compile_device: bool):
+        """Oracle-apply (+ compile when the doc serves from a lane) one
+        local edit. Returns (applied, ops-or-None); an invalid position
+        is counted and dropped — (False, None)."""
+        agent, pos, del_len, ins = event.payload
+        oracle = doc.oracle
+        live = len(oracle)
+        if pos > live or pos + del_len > live:
+            self.counters.incr("events_invalid")
+            return False, None
+        self._grow_table(doc, [agent])
+        aid = oracle.get_or_create_agent_id(agent)
+        seq0 = oracle.client_data[aid].get_next_seq()
+        o0 = oracle.get_next_order()
+        oracle.apply_local_txn(aid, [LocalOp(pos=pos, ins_content=ins,
+                                             del_span=del_len)])
+        doc.assigner.assign(doc.table.id_of(agent), seq0, event.items)
+        if not compile_device:
+            return True, None
+        ops, next_o = B.compile_local_patches(
+            [TestPatch(pos, del_len, ins)], rank=doc.table.rank_of(agent),
+            lmax=self.lmax, start_order=o0, dmax=None)
+        assert next_o == oracle.get_next_order()
+        return True, ops
+
+    def _apply_txn(self, doc: DocState, event: Event,
+                   compile_device: bool):
+        """Oracle-apply (+ compile) one released remote txn. A txn whose
+        references don't resolve (buggy/malicious peer beyond what the
+        causal buffer can see) is rejected typed-and-counted and the
+        buffer watermark rolled back so an honest redelivery lands."""
+        txn: RemoteTxn = event.payload
+        if not txn_refs_known(doc.oracle, txn):
+            self.counters.incr("txns_rejected")
+            doc.buffer.rollback_watermark(txn.id.agent, txn.id.seq)
+            return False, None
+        self._grow_table(doc, ShardRouter.txn_agent_names(txn))
+        doc.oracle.apply_remote_txn(txn)
+        if not compile_device:
+            # Host-only doc: advance the compiler's order metadata the
+            # exact way compile_remote_txns would (whole-txn span) but
+            # skip the tensor emission nothing will consume — with most
+            # docs host-only under lane pressure this is the bulk of a
+            # tick's host work.
+            agent = doc.table.id_of(txn.id.agent)
+            assert doc.assigner.next_seq(agent) == txn.id.seq
+            doc.assigner.assign(agent, txn.id.seq, event.items)
+            return True, None
+        ops, doc.assigner = B.compile_remote_txns(
+            [txn], doc.table, assigner=doc.assigner, lmax=self.lmax,
+            dmax=None)
+        return True, ops
+
+    def _drain_doc(self, doc: DocState, budget: int, compile_device: bool
+                   ) -> Tuple[Optional[B.OpTensors], List[Event], int]:
+        """Drain up to ``budget`` compiled steps of FIFO events from one
+        doc: oracle-apply each, compile each (lane docs only), concat.
+        Returns (tick stream or None, APPLIED events, steps) — rejected
+        or invalid events are dequeued but excluded from ``applied`` so
+        they feed neither the ops-applied stats nor latency samples."""
+        streams: List[B.OpTensors] = []
+        applied: List[Event] = []
+        steps = 0
+        while doc.events:
+            event = doc.events[0]
+            est = estimate_steps(doc, event, self.lmax)
+            if steps + est > budget:
+                break
+            doc.events.popleft()
+            self.router.admission.dequeued()
+            ok, ops = (self._apply_local(doc, event, compile_device)
+                       if event.kind == EV_LOCAL
+                       else self._apply_txn(doc, event, compile_device))
+            if not ok:
+                continue
+            applied.append(event)
+            if compile_device and ops is not None and ops.num_steps > 0:
+                streams.append(ops)
+                steps += ops.num_steps
+            elif not compile_device:
+                steps += est  # budget proxy: bounds host-side drain too
+        if not streams:
+            return None, applied, steps if compile_device else 0
+        return B.concat_ops(streams), applied, steps
+
+    # -- the tick -----------------------------------------------------------
+
+    def tick(self, tick_no: int) -> Dict[str, float]:
+        """One serving tick across all shards; returns tick stats."""
+        t0 = time.perf_counter()
+        stats = {"ops_applied": 0, "events_applied": 0, "steps": 0,
+                 "lanes_active": 0}
+
+        # 1. Residency: restore evicted docs with traffic, find lanes
+        #    for host-only docs (both may LRU-evict colder docs; both
+        #    stamp the doc's touch tick so a doc granted residency this
+        #    tick is never stolen later in the same pass).
+        for doc in self.router.docs.values():
+            if doc.events and not doc.resident:
+                self.residency.restore(doc, tick_no)
+            if (doc.events and doc.resident and not doc.in_lane
+                    and not doc.degraded):
+                self.residency.try_assign_lane(doc, tick_no)
+
+        # 2. Drain + compile per shard, apply in one device pass each.
+        #    Host-only docs drain without tensor emission (nothing would
+        #    consume the streams — the oracle apply is the whole serve).
+        applied_events: List[Event] = []
+        for shard, backend in enumerate(self.residency.backends):
+            lane_streams: Dict[int, B.OpTensors] = {}
+            host_only_applies = 0
+            budget = self.step_buckets[-1]
+            for doc in self.router.docs.values():
+                if doc.shard != shard or not doc.events:
+                    continue
+                if not doc.resident:
+                    continue  # restore deferred (no lane, no memory)
+                stream, applied, steps = self._drain_doc(
+                    doc, budget, compile_device=doc.in_lane)
+                applied_events.extend(applied)
+                stats["events_applied"] += len(applied)
+                stats["ops_applied"] += sum(e.items for e in applied)
+                if doc.in_lane and stream is not None:
+                    # Lane-capacity probe AFTER the oracle applied (the
+                    # oracle is truth): overflow degrades to host-only,
+                    # frees the lane, skips the device — never asserts.
+                    if backend.fits(doc.oracle.n,
+                                    doc.oracle.get_next_order()):
+                        lane_streams[doc.lane] = stream
+                        stats["steps"] += stream.num_steps
+                    else:
+                        self.residency.degrade(
+                            doc, f"lane capacity overflow: {doc.oracle.n} "
+                                 f"rows / {doc.oracle.get_next_order()} "
+                                 f"orders vs {backend.capacity}/"
+                                 f"{backend.order_capacity}")
+                elif not doc.in_lane and applied:
+                    host_only_applies += 1
+
+            if lane_streams:
+                s_max = max(s.num_steps for s in lane_streams.values())
+                s_bkt = self.bucket(s_max)
+                per_lane = [
+                    B.pad_ops(lane_streams.get(b, B.empty_ops(self.lmax)),
+                              s_bkt)
+                    for b in range(backend.lanes)
+                ]
+                stacked = B.stack_ops(per_lane)
+                backend.apply(stacked)
+                stats["lanes_active"] += len(lane_streams)
+                real = sum(s.num_steps for s in lane_streams.values())
+                self.counters.sample(
+                    "batch_fill_ratio",
+                    real / float(s_bkt * backend.lanes))
+                self.counters.incr("device_ticks")
+                self.counters.incr("device_steps", s_bkt)
+            self.counters.incr("host_only_applies", host_only_applies)
+
+        # 3. Barrier, then stamp admission->applied latency and sync
+        #    causal watermarks with the oracles' out-of-band progress
+        #    (local edits), releasing dependents for the next tick.
+        for backend in self.residency.backends:
+            backend.barrier()
+        now = time.perf_counter()
+        for event in applied_events:
+            self.latency_samples.append(now - event.t_submit)
+        for doc in self.router.docs.values():
+            if doc.resident:
+                released = doc.buffer.advance_watermarks(
+                    agent_watermarks(doc.oracle))
+                if released:
+                    self.router.enqueue_released(doc, released)
+        stats["tick_wall_s"] = now - t0
+        return stats

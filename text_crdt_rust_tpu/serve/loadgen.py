@@ -1,0 +1,374 @@
+"""Deterministic closed-loop load generator + convergence checker.
+
+N documents x M agents: every agent holds a real oracle replica of its
+document, edits it locally, gossips with its sibling agents, and ships
+its history to the server as binary TXNS frames through a seeded
+`net/faults.py` channel (drops / dups / reorders / truncations /
+bit-flips). A seeded Zipf popularity skew concentrates traffic on hot
+documents so the cold tail actually evicts. Local server-side edits mix
+in with probability ``local_prob`` (they also *touch* evicted docs,
+driving the restore path).
+
+Ground truth: one always-resident **twin** oracle per doc consumes the
+exact same txn set over a clean channel (plus the server's own edits,
+observed via ``export_since``). The run converges iff, after the lossy
+phase plus the server-driven REQUEST/re-delivery cycle, every document
+is bit-identical to its twin (string AND portable state digest) and
+every device lane is bit-identical to its host oracle — the ISSUE-3
+acceptance bar, CLI-runnable:
+
+    python -m text_crdt_rust_tpu.serve.loadgen --docs 200 --agents 3 \\
+        --ticks 60 --fault-rate 0.10 --seed 7
+"""
+from __future__ import annotations
+
+import argparse
+import random
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..common import RemoteTxn, txn_len
+from ..config import ServeConfig
+from ..models.oracle import ListCRDT
+from ..models.sync import agent_watermarks, export_txns_since, state_digest
+from ..net import codec
+from ..net.faults import FaultSpec, FaultyChannel
+from ..parallel.causal import CausalBuffer
+from .admission import AdmissionError
+from .server import DocServer
+
+TXNS_PER_FRAME = 4
+
+
+class _DocWorld:
+    """Generation-side state for one document: agent replicas, their
+    fault channels, the global txn log (generation order == a causal
+    order), and the clean twin."""
+
+    def __init__(self, doc_id: str, agents: List[str], seed: int,
+                 spec: FaultSpec):
+        self.doc_id = doc_id
+        self.agents = agents
+        self.replicas: Dict[str, ListCRDT] = {}
+        self.replica_ids: Dict[str, int] = {}
+        self.marks: Dict[str, int] = {a: 0 for a in agents}
+        self.applied: Dict[str, Set[Tuple[str, int]]] = {
+            a: set() for a in agents}
+        self.channels: Dict[str, FaultyChannel] = {}
+        for i, a in enumerate(agents):
+            doc = ListCRDT()
+            self.replicas[a] = doc
+            self.replica_ids[a] = doc.get_or_create_agent_id(a)
+            self.channels[a] = FaultyChannel(
+                spec=spec, seed=seed * 10007 + i)
+        self.txns: List[RemoteTxn] = []   # generation order, deduped
+        self.txn_keys: Set[Tuple[str, int]] = set()
+        self.twin = ListCRDT()
+        self.twin_buffer = CausalBuffer()
+        self.server_mark = 0
+
+    def record(self, txns: List[RemoteTxn]) -> List[RemoteTxn]:
+        fresh = []
+        for t in txns:
+            key = (t.id.agent, t.id.seq)
+            if key not in self.txn_keys:
+                self.txn_keys.add(key)
+                self.txns.append(t)
+                fresh.append(t)
+        return fresh
+
+    def feed_twin(self, txns: List[RemoteTxn]) -> None:
+        for t in self.twin_buffer.add_all(txns):
+            self.twin.apply_remote_txn(t)
+
+    def gossip(self, rng: random.Random, agent: str) -> None:
+        """The agent merges a random prefix of the doc's foreign
+        history (generation order is causal, so any prefix is safe —
+        the `perf/fuzz_mixed_fast.py` gen_stream recipe)."""
+        doc = self.replicas[agent]
+        seen = self.applied[agent]
+        upto = rng.randint(0, len(self.txns))
+        for t in self.txns[:upto]:
+            key = (t.id.agent, t.id.seq)
+            if t.id.agent != agent and key not in seen:
+                seen.add(key)
+                doc.apply_remote_txn(t)
+
+    def agent_edit(self, rng: random.Random, agent: str,
+                   edits: int) -> List[RemoteTxn]:
+        """A burst of local edits on the agent's replica; returns the
+        NEW txns exported since the agent's last export mark."""
+        doc = self.replicas[agent]
+        aid = self.replica_ids[agent]
+        for _ in range(edits):
+            n = len(doc)
+            if n == 0 or rng.random() < 0.55:
+                pos = rng.randint(0, n)
+                doc.local_insert(aid, pos, "".join(
+                    rng.choice("abcdefgh") for _ in range(rng.randint(1, 4))))
+            else:
+                pos = rng.randint(0, n - 1)
+                doc.local_delete(aid, pos, min(rng.randint(1, 4), n - pos))
+        out = export_txns_since(doc, self.marks[agent])
+        self.marks[agent] = doc.get_next_order()
+        return out
+
+
+class ServeLoadGen:
+    """Seeded closed loop against one ``DocServer``."""
+
+    def __init__(self, *, docs: int = 200, agents_per_doc: int = 3,
+                 ticks: int = 60, events_per_tick: int = 48,
+                 zipf_alpha: float = 1.1, fault_rate: float = 0.10,
+                 local_prob: float = 0.25, seed: int = 7,
+                 cfg: Optional[ServeConfig] = None,
+                 resync_every: int = 4, verbose: bool = False):
+        self.rng = random.Random(seed)
+        self.cfg = cfg or ServeConfig()
+        self.server = DocServer(self.cfg)
+        self.ticks = ticks
+        self.events_per_tick = events_per_tick
+        self.local_prob = local_prob
+        self.resync_every = max(1, resync_every)
+        self.verbose = verbose
+        spec = FaultSpec.all(fault_rate)
+        self.worlds: List[_DocWorld] = []
+        for d in range(docs):
+            doc_id = f"doc{d:04d}"
+            names = [f"d{d:04d}.a{i}" for i in range(agents_per_doc)]
+            self.worlds.append(_DocWorld(doc_id, names,
+                                         seed * 131 + d, spec))
+            self.server.admit_doc(doc_id)
+        # Zipf popularity over docs (rank 0 hottest).
+        self.weights = [1.0 / (i + 1) ** zipf_alpha for i in range(docs)]
+        self.rejections = 0
+        self.ops_offered = 0
+
+    # -- traffic -------------------------------------------------------------
+
+    def _ship(self, world: _DocWorld, agent: str,
+              txns: List[RemoteTxn], faulty: bool = True) -> None:
+        """Encode txns into frames and deliver them to the server,
+        optionally through the agent's fault channel."""
+        if not txns:
+            return
+        frames = [codec.encode_txns(txns[i:i + TXNS_PER_FRAME])
+                  for i in range(0, len(txns), TXNS_PER_FRAME)]
+        if faulty:
+            ch = world.channels[agent]
+            for f in frames:
+                ch.send(f)
+            frames = ch.drain()
+        for f in frames:
+            try:
+                self.server.submit_frame(world.doc_id, f)
+            except AdmissionError:
+                self.rejections += 1
+
+    def _gossip_digests(self, faulty: bool) -> None:
+        """Every agent advertises its replica's watermarks + portable
+        state digest — the anti-entropy signal that lets the server see
+        gaps whose every frame was dropped (a peer it has literally
+        never heard from)."""
+        for world in self.worlds:
+            for agent in world.agents:
+                replica = world.replicas[agent]
+                frame = codec.encode_digest(agent_watermarks(replica),
+                                            state_digest(replica))
+                if faulty:
+                    ch = world.channels[agent]
+                    ch.send(frame)
+                    frames = ch.drain()
+                else:
+                    frames = [frame]
+                for f in frames:
+                    try:
+                        self.server.submit_frame(world.doc_id, f)
+                    except AdmissionError:
+                        self.rejections += 1
+
+    def _resync(self, faulty: bool) -> int:
+        """Answer the server's owed REQUEST frames from the generation
+        log; returns how many docs still had wants."""
+        wanting = 0
+        for world in self.worlds:
+            req = self.server.poll_request_frame(world.doc_id)
+            if req is None:
+                continue
+            wanting += 1
+            kind, wants, _ = codec.decode_frame(req)
+            assert kind == codec.KIND_REQUEST
+            owed = [t for t in world.txns
+                    if t.id.agent in wants
+                    and t.id.seq + txn_len(t) > wants[t.id.agent]]
+            # Deliver via the hottest agent's channel (any path works;
+            # the server dedups) — clean in the final drain.
+            self._ship(world, world.agents[0], owed, faulty=faulty)
+        return wanting
+
+    def _observe_server_edits(self) -> None:
+        """Feed the twins whatever new history the server produced
+        (its own local edits, interleaved with merges)."""
+        for world in self.worlds:
+            doc = self.server.doc_state(world.doc_id)
+            if not doc.resident:
+                continue
+            nxt = doc.oracle.get_next_order()
+            if nxt > world.server_mark:
+                txns = self.server.export_since(world.doc_id,
+                                                world.server_mark)
+                world.server_mark = nxt
+                world.feed_twin(txns)
+
+    def run_tick(self, tick_index: int) -> Dict[str, float]:
+        picks = self.rng.choices(range(len(self.worlds)),
+                                 weights=self.weights,
+                                 k=self.events_per_tick)
+        for d in picks:
+            world = self.worlds[d]
+            if self.rng.random() < self.local_prob:
+                # A server-side edit; position bounded by the doc's
+                # current live length when resident, 0 (always valid)
+                # while evicted — the touch drives the restore path.
+                doc = self.server.doc_state(world.doc_id)
+                live = len(doc.oracle) if doc.resident else 0
+                pos = self.rng.randint(0, live)
+                ins = "".join(self.rng.choice("xyzw")
+                              for _ in range(self.rng.randint(1, 3)))
+                try:
+                    self.server.submit_local(world.doc_id, "server-editor",
+                                             pos, 0, ins)
+                    self.ops_offered += len(ins)
+                except AdmissionError:
+                    self.rejections += 1
+            else:
+                agent = self.rng.choice(world.agents)
+                world.gossip(self.rng, agent)
+                txns = world.agent_edit(self.rng, agent,
+                                        self.rng.randint(1, 3))
+                fresh = world.record(txns)
+                world.feed_twin(fresh)
+                self.ops_offered += sum(txn_len(t) for t in fresh)
+                self._ship(world, agent, txns, faulty=True)
+        if (tick_index + 1) % self.resync_every == 0:
+            self._gossip_digests(faulty=True)
+            self._resync(faulty=True)
+        stats = self.server.tick()
+        self._observe_server_edits()
+        return stats
+
+    # -- the full run --------------------------------------------------------
+
+    def run(self) -> Dict[str, object]:
+        t0 = time.perf_counter()
+        applied = 0
+        steps = 0
+        for i in range(self.ticks):
+            stats = self.run_tick(i)
+            applied += stats["ops_applied"]
+            steps += stats["steps"]
+            if self.verbose and (i + 1) % 10 == 0:
+                rc = self.server.residency.resident_counts()
+                print(f"tick {i + 1}/{self.ticks}: applied {applied} "
+                      f"item-ops, {rc['docs_in_lane']} in-lane / "
+                      f"{rc['docs_evicted']} evicted", flush=True)
+        loop_wall = time.perf_counter() - t0
+
+        # Final drain: clean digests + re-delivery until the server owes
+        # no REQUESTs and every queue is empty — the anti-entropy cycle
+        # that recovers everything the fault channels mangled.
+        drain_rounds = 0
+        self._gossip_digests(faulty=False)
+        for drain_rounds in range(1, 64):
+            wanting = self._resync(faulty=False)
+            self.server.tick()
+            self._observe_server_edits()
+            busy = any(d.events for d in self.server.router.docs.values())
+            if not wanting and not busy:
+                break
+        self.server.drain()
+        self._observe_server_edits()
+
+        converged, mismatches = self.verify()
+        wall = time.perf_counter() - t0
+        stats = self.server.stats()
+        report = {
+            "converged": converged,
+            "mismatches": mismatches[:8],
+            "docs": len(self.worlds),
+            "item_ops_applied": int(applied),
+            "device_ticks_wall_s": round(loop_wall, 3),
+            "ops_per_sec": round(applied / loop_wall, 1) if loop_wall else 0,
+            "drain_rounds": drain_rounds,
+            "wall_s": round(wall, 3),
+            "rejected_submissions": self.rejections,
+            "latency_us": self.server.latency_summary(),
+            "server": stats,
+        }
+        return report
+
+    def verify(self) -> Tuple[bool, List[str]]:
+        """Every doc bit-identical to its twin; every lane bit-identical
+        to its oracle. Returns (ok, mismatch descriptions)."""
+        bad: List[str] = []
+        for world in self.worlds:
+            # Docs evicted at run end: restore, then feed the twin any
+            # server-authored history it hasn't observed yet (the doc
+            # may have been checkpointed right after its last edit).
+            self.server.ensure_resident(world.doc_id)
+        self._observe_server_edits()
+        for world in self.worlds:
+            # The twin must itself have fully converged (a generation
+            # bug otherwise — every generated txn was fed cleanly).
+            if world.twin_buffer.pending:
+                bad.append(f"{world.doc_id}: twin buffer still holds "
+                           f"{world.twin_buffer.pending} txns")
+                continue
+            got = self.server.doc_string(world.doc_id)
+            want = world.twin.to_string()
+            if got != want:
+                bad.append(f"{world.doc_id}: content diverged "
+                           f"({len(got)} vs {len(want)} chars)")
+                continue
+            doc = self.server.doc_state(world.doc_id)
+            if state_digest(doc.oracle) != state_digest(world.twin):
+                bad.append(f"{world.doc_id}: state digest diverged")
+                continue
+            if not self.server.verify_doc(world.doc_id):
+                bad.append(f"{world.doc_id}: device lane != host oracle")
+        return not bad, bad
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--docs", type=int, default=200)
+    ap.add_argument("--agents", type=int, default=3)
+    ap.add_argument("--ticks", type=int, default=60)
+    ap.add_argument("--events-per-tick", type=int, default=48)
+    ap.add_argument("--zipf", type=float, default=1.1)
+    ap.add_argument("--fault-rate", type=float, default=0.10)
+    ap.add_argument("--local-prob", type=float, default=0.25)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--shards", type=int, default=2)
+    ap.add_argument("--lanes", type=int, default=16)
+    ap.add_argument("--verbose", action="store_true")
+    a = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    cfg = ServeConfig(num_shards=a.shards, lanes_per_shard=a.lanes)
+    gen = ServeLoadGen(docs=a.docs, agents_per_doc=a.agents, ticks=a.ticks,
+                       events_per_tick=a.events_per_tick, zipf_alpha=a.zipf,
+                       fault_rate=a.fault_rate, local_prob=a.local_prob,
+                       seed=a.seed, cfg=cfg, verbose=a.verbose)
+    report = gen.run()
+    import json
+
+    print(json.dumps(report, indent=1, default=str))
+    if not report["converged"]:
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

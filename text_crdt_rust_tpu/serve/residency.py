@@ -1,0 +1,194 @@
+"""Doc lifecycle: admit -> resident-in-lane -> evicted-to-checkpoint.
+
+An LRU policy over the shards' device lanes. The working set of hot
+documents lives in lanes (device-accelerated); colder documents fall
+back in two graceful stages, neither of which is ever an assert:
+
+- **host-only** — oracle in memory, no lane (all lanes hotter, or the
+  doc outgrew the lane capacity and is permanently ``degraded``). Ticks
+  still apply its events to the oracle; the next lane acquisition
+  re-seeds device state wholesale via ``upload_lane`` (the flat
+  backend's ``span_arrays.upload_oracle`` warm-start path).
+- **evicted** — the oracle is serialized through ``utils/checkpoint.py``
+  (FORMAT_VERSION 2, CRC-guarded: a restore is bit-perfect or refuses)
+  and dropped from memory. The doc's ``CausalBuffer`` and event queue
+  stay live, so peer traffic keeps accumulating causally while the doc
+  is out. A later touch restores: ``load_doc`` rebuilds the oracle,
+  ``OrderAssigner.from_oracle`` rebuilds the compiler state, and the
+  queued events replay through the normal tick path — the
+  edited-by-peers-while-out invariant ``tests/test_serve_residency.py``
+  pins against an always-resident twin.
+
+Eviction preference: least-recently-touched lane doc without pending
+events; a victim touched in the current tick is never stolen (the
+restored doc serves host-only for a tick instead — bounded, no
+livelock). The analog of paged-out KV cache + prompt re-upload in LLM
+serving: restore costs O(doc), correctness costs nothing.
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Dict, List, Optional
+
+from ..ops import batch as B
+from ..utils import checkpoint
+from ..utils.metrics import Counters
+from .batcher import oracle_signed
+from .router import DocState, ShardRouter
+
+
+class LaneResidency:
+    """Lane ownership + the evict/restore state machine."""
+
+    def __init__(self, backends: List, router: ShardRouter, *,
+                 spool_dir: Optional[str] = None,
+                 counters: Optional[Counters] = None):
+        self.backends = backends
+        self.router = router
+        self.counters = counters if counters is not None else Counters()
+        self.spool_dir = spool_dir or tempfile.mkdtemp(prefix="tcr_serve_")
+        os.makedirs(self.spool_dir, exist_ok=True)
+        # lane_owner[shard][lane] -> doc_id | None
+        self.lane_owner: List[List[Optional[str]]] = [
+            [None] * b.lanes for b in backends
+        ]
+        self._ckpt_ids: Dict[str, int] = {}
+
+    # -- introspection -------------------------------------------------------
+
+    def resident_counts(self) -> Dict[str, int]:
+        in_lane = sum(1 for s in self.lane_owner for d in s if d)
+        docs = self.router.docs.values()
+        return {
+            "docs_total": len(self.router.docs),
+            "docs_in_lane": in_lane,
+            "docs_host_only": sum(1 for d in docs
+                                  if d.resident and not d.in_lane),
+            "docs_evicted": sum(1 for d in docs if d.evicted),
+            "docs_degraded": sum(1 for d in docs if d.degraded),
+        }
+
+    def _ckpt_path(self, doc_id: str) -> str:
+        # Stable, filesystem-safe name per doc (ids are arbitrary strings).
+        if doc_id not in self._ckpt_ids:
+            self._ckpt_ids[doc_id] = len(self._ckpt_ids)
+        return os.path.join(self.spool_dir,
+                            f"doc_{self._ckpt_ids[doc_id]:06d}.npz")
+
+    # -- lane allocation -----------------------------------------------------
+
+    def _free_lane(self, shard: int) -> Optional[int]:
+        for lane, owner in enumerate(self.lane_owner[shard]):
+            if owner is None:
+                return lane
+        return None
+
+    def _lru_victim(self, shard: int, tick_no: int) -> Optional[DocState]:
+        """Least-recently-touched lane doc of ``shard`` that is safe to
+        steal from: prefer docs with no pending events; never one
+        touched this tick."""
+        docs = [self.router.docs[d] for d in self.lane_owner[shard] if d]
+        docs = [d for d in docs if d.last_touch_tick < tick_no]
+        if not docs:
+            return None
+        idle = [d for d in docs if not d.events]
+        pool = idle or docs
+        return min(pool, key=lambda d: d.last_touch_tick)
+
+    def try_assign_lane(self, doc: DocState, tick_no: int) -> bool:
+        """Find ``doc`` a lane on its shard (evicting the LRU victim if
+        none is free). False = stay host-only this tick (every lane is
+        hotter) — a deferral, not a failure."""
+        assert doc.resident and not doc.in_lane
+        backend = self.backends[doc.shard]
+        if not backend.fits(doc.oracle.n, doc.oracle.get_next_order()):
+            self.degrade(doc, f"doc ({doc.oracle.n} rows, "
+                              f"{doc.oracle.get_next_order()} orders) "
+                              f"exceeds lane capacity "
+                              f"{backend.capacity}/{backend.order_capacity}")
+            return False
+        lane = self._free_lane(doc.shard)
+        if lane is None:
+            victim = self._lru_victim(doc.shard, tick_no)
+            if victim is None:
+                self.counters.incr("lane_acquire_deferred")
+                return False
+            self.evict(victim)
+            lane = self._free_lane(doc.shard)
+            assert lane is not None
+        doc.lane = lane
+        # Granting a lane IS a touch: without the stamp, every doc's
+        # last_touch_tick predates this tick (submissions happen between
+        # ticks) and the LRU's touched-this-tick guard would be vacuous
+        # — a doc restored early in the residency pass could be stolen
+        # again later in the SAME pass, stalling its queued events.
+        doc.last_touch_tick = tick_no
+        self.lane_owner[doc.shard][lane] = doc.doc_id
+        backend.upload_lane(lane, doc.oracle, doc.table.rank_of_agent())
+        self.counters.incr("lane_uploads")
+        return True
+
+    def release_lane(self, doc: DocState) -> None:
+        if not doc.in_lane:
+            return
+        self.backends[doc.shard].clear_lane(doc.lane)
+        self.lane_owner[doc.shard][doc.lane] = None
+        doc.lane = None
+
+    def degrade(self, doc: DocState, reason: str) -> None:
+        """Capacity overflow: host-oracle-only from here on (the
+        ``DeviceMirror`` degrade contract — never an assert)."""
+        self.release_lane(doc)
+        doc.degraded = True
+        doc.degrade_reason = reason
+        self.counters.incr("lane_overflow_degraded")
+
+    # -- evict / restore -----------------------------------------------------
+
+    def evict(self, doc: DocState) -> str:
+        """Serialize the oracle to its CRC-guarded checkpoint, drop the
+        in-memory state, free the lane. The causal buffer and event
+        queue survive in memory (peers keep editing the doc while it is
+        out). Returns the checkpoint path."""
+        assert doc.resident, "evicting an already-evicted doc"
+        path = self._ckpt_path(doc.doc_id)
+        checkpoint.save_doc(doc.oracle, path)
+        doc.ckpt_path = path
+        doc.oracle = None
+        doc.table = None
+        doc.assigner = None
+        doc.evicted = True
+        self.release_lane(doc)
+        self.counters.incr("evictions")
+        return path
+
+    def restore(self, doc: DocState, tick_no: Optional[int] = None) -> None:
+        """Rebuild the full in-memory state from the checkpoint. Raises
+        ``CheckpointError`` on a corrupt file (refusing beats silently
+        serving garbage); queued events then replay via the normal tick
+        path, so 'restored + replayed' is bit-identical to
+        never-evicted. ``tick_no`` stamps the touch so the same tick's
+        LRU pass cannot immediately re-evict the doc it just restored."""
+        assert doc.evicted and doc.ckpt_path
+        oracle = checkpoint.load_doc(doc.ckpt_path)
+        doc.oracle = oracle
+        doc.table = B.AgentTable([cd.name for cd in oracle.client_data])
+        doc.assigner = B.OrderAssigner.from_oracle(oracle, doc.table)
+        doc.evicted = False
+        if tick_no is not None:
+            doc.last_touch_tick = tick_no
+        self.counters.incr("restores")
+
+    # -- verification --------------------------------------------------------
+
+    def verify_lane(self, doc: DocState) -> bool:
+        """Device lane state bit-identical to the host oracle: the same
+        ±(order+1) body column, row for row."""
+        if not doc.in_lane:
+            return True
+        import numpy as np
+
+        got = self.backends[doc.shard].lane_signed(doc.lane)
+        want = oracle_signed(doc.oracle)
+        return got.shape == want.shape and bool(np.array_equal(got, want))

@@ -1,0 +1,339 @@
+"""Doc-shard routing: wire frames and local edits -> per-doc causal queues.
+
+The router owns the ``doc_id -> (shard, lane)`` table for the server's
+B-lane device batches and the per-document host state behind it. One
+``DocState`` per admitted document:
+
+- a host **oracle** (`models.oracle.ListCRDT`) — the source of truth the
+  device lanes mirror, and what eviction serializes (``None`` while the
+  doc is evicted to its checkpoint);
+- the op **compiler state** (`ops.batch.AgentTable` + ``OrderAssigner``)
+  kept aligned with the oracle so tick-time compilation resumes
+  mid-history (rebuilt via ``OrderAssigner.from_oracle`` on restore);
+- a bounded ``parallel.causal.CausalBuffer`` fronting all remote
+  traffic, so the server inherits PR 1's gap/duplicate/out-of-order
+  handling for free — frames from a lossy network release in causal
+  order or wait, and ``missing()`` feeds the REQUEST frames the server
+  emits to pull lost ranges;
+- a FIFO **event queue** of causally-ready work (released remote txns +
+  local edits) the batcher drains at tick time. FIFO per doc preserves
+  the release order, so every apply is causally valid.
+
+Frames arrive as bytes and are decoded through ``net/codec.py``; any
+``CodecError`` becomes a counted, typed admission refusal — corrupt
+input can never crash the serving loop (`net/faults.py` is the test
+model). The doc id itself is connection metadata (the wire frame format
+is doc-agnostic), so the submit surface is ``(doc_id, frame_bytes)``.
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+from ..common import RemoteIns, RemoteTxn, txn_len
+from ..models.oracle import ListCRDT
+from ..models.sync import (
+    agent_watermarks,
+    export_txns_for_wants,
+    export_txns_since,
+    state_digest,
+)
+from ..net import codec
+from ..net.codec import CodecError
+from ..ops import batch as B
+from ..parallel.causal import CausalBuffer
+from ..utils.metrics import Counters
+from .admission import AdmissionControl
+
+# Event kinds in a doc's FIFO queue.
+EV_TXN = "txn"      # payload: a causally-ready RemoteTxn
+EV_LOCAL = "local"  # payload: (agent_name, pos, del_len, ins_content)
+
+
+class Event:
+    """One unit of causally-ready work. ``t_submit`` is the ADMISSION
+    time (callers pass the stamp recorded when the txn entered the
+    server, so a txn's causal-buffer wait — the fault-induced tail the
+    latency metric exists to expose — is inside admission->applied)."""
+
+    __slots__ = ("kind", "payload", "items", "t_submit", "tick_submit")
+
+    def __init__(self, kind: str, payload, items: int, tick: int,
+                 t_submit: Optional[float] = None):
+        self.kind = kind
+        self.payload = payload
+        self.items = items
+        self.t_submit = (time.perf_counter() if t_submit is None
+                         else t_submit)
+        self.tick_submit = tick
+
+
+class DocState:
+    """Everything the server holds for one document."""
+
+    def __init__(self, doc_id: str, shard: int,
+                 max_pending: Optional[int] = None):
+        self.doc_id = doc_id
+        self.shard = shard
+        self.lane: Optional[int] = None
+        self.oracle: Optional[ListCRDT] = ListCRDT()
+        self.table: Optional[B.AgentTable] = B.AgentTable()
+        self.assigner: Optional[B.OrderAssigner] = B.OrderAssigner(self.table)
+        self.buffer = CausalBuffer(max_pending=max_pending)
+        self.events: Deque[Event] = deque()
+        self.evicted = False
+        self.ckpt_path: Optional[str] = None
+        # (agent, seq) -> admission perf_counter stamp for txns still in
+        # the causal buffer, so their eventual Event carries the TRUE
+        # admission time (first delivery wins; trims look up the nearest
+        # covering stamp). Pruned against the buffer watermark.
+        self.submit_stamps: Dict[Tuple[str, int], float] = {}
+        # Latest per-agent watermarks any peer DIGEST advertised: the
+        # gossip that reveals gaps the causal buffer cannot see (every
+        # frame from an agent dropped), exactly as in `net/session.py`.
+        self.peer_marks: Dict[str, int] = {}
+        self.degraded = False          # lane overflow: host-only forever
+        self.degrade_reason = ""
+        self.last_touch_tick = 0
+        self.divergence_detected = False
+
+    @property
+    def resident(self) -> bool:
+        """Oracle in memory (lane-backed or host-only)."""
+        return self.oracle is not None
+
+    @property
+    def in_lane(self) -> bool:
+        return self.lane is not None
+
+    def pending(self) -> int:
+        return len(self.events) + self.buffer.pending
+
+
+class ShardRouter:
+    """doc_id -> (shard, lane) table + the frame/edit submit surface.
+
+    Shard assignment is least-loaded-at-admit and stable for the doc's
+    lifetime (a doc's lane may come and go with residency, its shard
+    never does — evicting to a different shard would orphan its device
+    state). Lane assignment belongs to ``serve/residency.py``.
+    """
+
+    def __init__(self, num_shards: int, *, admission: AdmissionControl,
+                 counters: Optional[Counters] = None,
+                 buffer_max_pending: Optional[int] = 512):
+        assert num_shards >= 1
+        self.num_shards = num_shards
+        self.admission = admission
+        self.counters = counters if counters is not None else Counters()
+        self.buffer_max_pending = buffer_max_pending
+        self.docs: Dict[str, DocState] = {}
+        self._shard_docs = [0] * num_shards
+        self._tick = 0
+
+    # -- doc lifecycle surface (driven by the server facade) ----------------
+
+    def set_tick(self, tick: int) -> None:
+        self._tick = tick
+
+    def admit_doc(self, doc_id: str) -> DocState:
+        """Register a new empty document; idempotent on the same id."""
+        doc = self.docs.get(doc_id)
+        if doc is not None:
+            return doc
+        shard = min(range(self.num_shards), key=lambda s: self._shard_docs[s])
+        doc = DocState(doc_id, shard, max_pending=self.buffer_max_pending)
+        doc.last_touch_tick = self._tick
+        self.docs[doc_id] = doc
+        self._shard_docs[shard] += 1
+        self.counters.incr("docs_admitted")
+        return doc
+
+    def doc(self, doc_id: str) -> DocState:
+        doc = self.docs.get(doc_id)
+        if doc is None:
+            raise self.admission.reject_unknown_doc(doc_id)
+        return doc
+
+    def shard_lane(self, doc_id: str) -> Tuple[int, Optional[int]]:
+        doc = self.doc(doc_id)
+        return doc.shard, doc.lane
+
+    # -- submit surface -----------------------------------------------------
+
+    def _enqueue(self, doc: DocState, event: Event) -> None:
+        doc.events.append(event)
+        self.admission.enqueued()
+        doc.last_touch_tick = self._tick
+
+    def _pop_stamp(self, doc: DocState, txn: RemoteTxn) -> Optional[float]:
+        """Admission stamp for a released txn: exact (agent, seq) hit,
+        else the nearest earlier same-agent stamp (the buffer trims
+        already-known prefixes, shifting the released seq forward)."""
+        key = (txn.id.agent, txn.id.seq)
+        t = doc.submit_stamps.pop(key, None)
+        if t is not None:
+            return t
+        best = None
+        for (agent, seq), stamp in doc.submit_stamps.items():
+            if agent == txn.id.agent and seq <= txn.id.seq:
+                if best is None or seq > best[0]:
+                    best = (seq, stamp)
+        if best is not None:
+            doc.submit_stamps.pop((txn.id.agent, best[0]), None)
+            return best[1]
+        return None
+
+    def _prune_stamps(self, doc: DocState) -> None:
+        """Stamps whose seqs the buffer watermark already covers belong
+        to duplicates that will never release — drop them (bounds the
+        dict against duplicate-heavy re-deliveries)."""
+        if len(doc.submit_stamps) <= 1024:
+            return
+        marks = doc.buffer.watermarks()
+        for key in [k for k in doc.submit_stamps
+                    if k[1] < marks.get(k[0], 0)]:
+            del doc.submit_stamps[key]
+
+    def enqueue_released(self, doc: DocState,
+                         released: List[RemoteTxn]) -> None:
+        """Queue causally-released txns as events carrying their
+        ADMISSION stamps (a release must never be refused — refusing it
+        would desync the buffer watermark)."""
+        for txn in released:
+            self._enqueue(doc, Event(EV_TXN, txn, txn_len(txn), self._tick,
+                                     t_submit=self._pop_stamp(doc, txn)))
+
+    def submit_txn(self, doc_id: str, txn: RemoteTxn) -> None:
+        """Admit one remote txn (already decoded) into the doc's causal
+        queue. Raises ``AdmissionError``; on success the txn is either
+        released into the event FIFO or held in the causal buffer."""
+        doc = self.doc(doc_id)
+        self.admission.admit(doc_id, txn.id.agent, txn_len(txn),
+                             doc.pending(), self._tick)
+        self._ingest_txn(doc, txn)
+
+    def _ingest_txn(self, doc: DocState, txn: RemoteTxn) -> None:
+        doc.submit_stamps.setdefault((txn.id.agent, txn.id.seq),
+                                     time.perf_counter())
+        self._prune_stamps(doc)
+        released = doc.buffer.add(txn)
+        doc.last_touch_tick = self._tick
+        self.enqueue_released(doc, released)
+
+    def submit_local(self, doc_id: str, agent: str, pos: int,
+                     del_len: int = 0, ins_content: str = "") -> None:
+        """Admit one local edit (the server is the authoring peer)."""
+        items = del_len + len(ins_content)
+        if items <= 0:
+            return
+        doc = self.doc(doc_id)
+        self.admission.admit(doc_id, agent, items, doc.pending(),
+                             self._tick)
+        self._enqueue(doc, Event(EV_LOCAL, (agent, pos, del_len,
+                                            ins_content), items, self._tick))
+
+    def submit_frame(self, doc_id: str, data: bytes) -> List[bytes]:
+        """Ingest one wire frame for ``doc_id``; returns response frames
+        (served REQUESTs). Corrupt bytes raise a typed, counted
+        ``AdmissionError`` — never an uncaught decode error."""
+        doc = self.doc(doc_id)
+        try:
+            kind, value, _ = codec.decode_frame(data)
+        except CodecError as e:
+            raise self.admission.reject_frame(str(e)) from None
+        self.counters.incr("frames_received")
+
+        if kind == codec.KIND_TXNS:
+            # Two-phase: admission-CHECK every txn in the frame first,
+            # then ingest — a mid-frame refusal must not leave a prefix
+            # enqueued behind a raised AdmissionError (all-or-nothing
+            # per frame; checked-prefix rate tokens are consumed).
+            for i, txn in enumerate(value):
+                self.admission.check(doc_id, txn.id.agent, txn_len(txn),
+                                     doc.pending() + i, self._tick)
+            for txn in value:
+                self.admission.count_admitted(txn_len(txn))
+                self._ingest_txn(doc, txn)
+            return []
+
+        if kind == codec.KIND_REQUEST:
+            # Serve the pull from the oracle when it is in memory; an
+            # evicted doc registers the touch (restore happens at the
+            # next tick) and the peer re-asks — a retry, not an error.
+            doc.last_touch_tick = self._tick
+            if not doc.resident:
+                self.counters.incr("requests_deferred_evicted")
+                return []
+            txns = export_txns_for_wants(doc.oracle, value)
+            out = []
+            for i in range(0, len(txns), 8):
+                out.append(codec.encode_txns(txns[i:i + 8]))
+            self.counters.incr("requests_served")
+            return out
+
+        # KIND_DIGEST: watermark gossip (reveals agents whose frames were
+        # ALL lost — the causal buffer alone can't see those gaps; the
+        # next ``poll_request_frame`` pulls them) + divergence detection
+        # (equal watermarks, unequal digests = the must-never-happen
+        # CRDT failure, surfaced loudly).
+        marks, digest = value
+        for agent, wm in marks.items():
+            if wm > doc.peer_marks.get(agent, 0):
+                doc.peer_marks[agent] = wm
+        if doc.resident and not doc.events:
+            mine = agent_watermarks(doc.oracle)
+            if marks == mine and digest != state_digest(doc.oracle):
+                doc.divergence_detected = True
+                self.counters.incr("divergence_detected")
+        return []
+
+    # -- pull / export surface ---------------------------------------------
+
+    def poll_request_frame(self, doc_id: str) -> Optional[bytes]:
+        """The REQUEST frame this doc currently owes its peers: the
+        causal buffer's missing-range frontier (gaps from dropped or
+        corrupted frames) PLUS gaps only peer digests reveal (an agent
+        whose every frame was lost). None when nothing is missing."""
+        doc = self.doc(doc_id)
+        wants: Dict[str, int] = {}
+        for rid in doc.buffer.missing():
+            wants[rid.agent] = min(wants.get(rid.agent, rid.seq), rid.seq)
+        marks = dict(doc.buffer.watermarks())
+        if doc.resident:
+            for agent, wm in agent_watermarks(doc.oracle).items():
+                marks[agent] = max(marks.get(agent, 0), wm)
+        for agent, peer_wm in doc.peer_marks.items():
+            mine = marks.get(agent, 0)
+            if peer_wm > mine:
+                wants[agent] = min(wants.get(agent, mine), mine)
+        if not wants:
+            return None
+        self.counters.incr("range_requests")
+        return codec.encode_request(wants)
+
+    def export_since(self, doc_id: str, start_order: int
+                     ) -> List[RemoteTxn]:
+        """History with order >= start_order — how downstream replicas
+        (and the loadgen's twins) observe server-authored edits."""
+        doc = self.doc(doc_id)
+        assert doc.resident, "export from an evicted doc (restore first)"
+        return export_txns_since(doc.oracle, start_order)
+
+    @staticmethod
+    def txn_agent_names(txn: RemoteTxn) -> set:
+        """Every agent name a txn references (author, parents, origins,
+        delete targets) — what must exist in the doc's AgentTable before
+        the txn compiles."""
+        names = {txn.id.agent}
+        for p in txn.parents:
+            names.add(p.agent)
+        for op in txn.ops:
+            if isinstance(op, RemoteIns):
+                names.add(op.origin_left.agent)
+                names.add(op.origin_right.agent)
+            else:
+                names.add(op.id.agent)
+        names.discard("ROOT")
+        return names

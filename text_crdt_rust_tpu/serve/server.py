@@ -1,0 +1,145 @@
+"""The single-process document server: router + batcher + residency +
+admission wired behind one facade.
+
+Usage shape (see ``serve/loadgen.py`` for the closed-loop driver):
+
+    server = DocServer(ServeConfig(num_shards=2, lanes_per_shard=16))
+    server.admit_doc("doc-7")
+    server.submit_frame("doc-7", frame_bytes)       # remote peer traffic
+    server.submit_local("doc-7", "editor", pos=0, ins_content="hi")
+    server.tick()                                    # one batched step
+    server.poll_request_frame("doc-7")               # owed REQUESTs
+
+Everything user-facing is total: overload and malformed input raise
+typed ``AdmissionError``s, capacity overflow degrades to the host
+oracle, eviction/restore is CRC-guarded — the invariant under all of it
+being YATA convergence: after any interleaving of ticks, evictions,
+restores, faults and re-requests, every doc is bit-identical to a
+replica that saw the same ops cleanly.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..common import RemoteTxn
+from ..config import ServeConfig
+from ..models.sync import state_digest
+from ..utils.metrics import Counters, percentiles
+from .admission import AdmissionControl
+from .batcher import ContinuousBatcher, make_lane_backend
+from .residency import LaneResidency
+from .router import DocState, ShardRouter
+
+
+class DocServer:
+    """One process, ``num_shards`` device batches, thousands of docs."""
+
+    def __init__(self, cfg: Optional[ServeConfig] = None,
+                 counters: Optional[Counters] = None):
+        self.cfg = cfg = cfg or ServeConfig()
+        assert cfg.max_txn_len <= cfg.step_buckets[-1], (
+            f"max_txn_len {cfg.max_txn_len} exceeds the largest step "
+            f"bucket {cfg.step_buckets[-1]}: an admitted event could "
+            f"never fit a tick")
+        self.counters = counters if counters is not None else Counters()
+        self.admission = AdmissionControl(
+            max_queue_per_doc=cfg.max_queue_per_doc,
+            max_queue_global=cfg.max_queue_global,
+            max_txn_len=cfg.max_txn_len,
+            rate_capacity=cfg.rate_capacity,
+            rate_refill=cfg.rate_refill,
+            counters=self.counters)
+        self.router = ShardRouter(cfg.num_shards, admission=self.admission,
+                                  counters=self.counters)
+        backends = [
+            make_lane_backend(cfg.engine, lanes=cfg.lanes_per_shard,
+                              capacity=cfg.lane_capacity,
+                              order_capacity=cfg.order_capacity,
+                              lmax=cfg.lmax)
+            for _ in range(cfg.num_shards)
+        ]
+        self.residency = LaneResidency(backends, self.router,
+                                       spool_dir=cfg.spool_dir,
+                                       counters=self.counters)
+        self.batcher = ContinuousBatcher(self.router, self.residency,
+                                         step_buckets=cfg.step_buckets,
+                                         lmax=cfg.lmax,
+                                         counters=self.counters)
+        self.tick_no = 0
+
+    # -- traffic surface ----------------------------------------------------
+
+    def admit_doc(self, doc_id: str) -> None:
+        self.router.admit_doc(doc_id)
+
+    def submit_frame(self, doc_id: str, data: bytes) -> List[bytes]:
+        return self.router.submit_frame(doc_id, data)
+
+    def submit_txn(self, doc_id: str, txn: RemoteTxn) -> None:
+        self.router.submit_txn(doc_id, txn)
+
+    def submit_local(self, doc_id: str, agent: str, pos: int,
+                     del_len: int = 0, ins_content: str = "") -> None:
+        self.router.submit_local(doc_id, agent, pos, del_len, ins_content)
+
+    def poll_request_frame(self, doc_id: str) -> Optional[bytes]:
+        return self.router.poll_request_frame(doc_id)
+
+    def export_since(self, doc_id: str, start_order: int):
+        return self.router.export_since(doc_id, start_order)
+
+    # -- the serving loop ---------------------------------------------------
+
+    def tick(self) -> Dict[str, float]:
+        self.tick_no += 1
+        self.router.set_tick(self.tick_no)
+        return self.batcher.tick(self.tick_no)
+
+    def drain(self, max_ticks: int = 64) -> int:
+        """Tick until every queue is empty (or the budget runs out);
+        returns ticks spent. Pending = undrained events only — txns
+        blocked in causal buffers need peer re-delivery, not ticks."""
+        for i in range(max_ticks):
+            if not any(d.events for d in self.router.docs.values()):
+                return i
+            self.tick()
+        return max_ticks
+
+    # -- inspection / verification ------------------------------------------
+
+    def doc_state(self, doc_id: str) -> DocState:
+        return self.router.doc(doc_id)
+
+    def ensure_resident(self, doc_id: str) -> DocState:
+        doc = self.router.doc(doc_id)
+        if not doc.resident:
+            self.residency.restore(doc)
+        return doc
+
+    def doc_string(self, doc_id: str) -> str:
+        return self.ensure_resident(doc_id).oracle.to_string()
+
+    def doc_digest(self, doc_id: str) -> int:
+        return state_digest(self.ensure_resident(doc_id).oracle)
+
+    def verify_doc(self, doc_id: str) -> bool:
+        """Lane (if any) bit-identical to the host oracle."""
+        doc = self.router.doc(doc_id)
+        if not doc.resident:
+            return True
+        return self.residency.verify_lane(doc)
+
+    def latency_summary(self) -> Dict[str, float]:
+        """Admission->applied latency percentiles in microseconds."""
+        us = [s * 1e6 for s in self.batcher.latency_samples]
+        out = {k: round(v, 1)
+               for k, v in percentiles(us, (50, 99)).items()}
+        out["samples"] = len(us)
+        return out
+
+    def stats(self) -> Dict[str, float]:
+        out = dict(self.counters.summary())
+        out.update(self.residency.resident_counts())
+        out.update({f"latency_us_{k}": v
+                    for k, v in self.latency_summary().items()})
+        return out
