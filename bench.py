@@ -1122,12 +1122,20 @@ def cfg_serve(args):
     counts, docs resident vs total, and the p50/p99 admission->applied
     latency; ``oracle_equal`` is the ISSUE-3 acceptance bar (every doc
     bit-identical to its host-oracle twin AND every device lane
-    bit-identical to its oracle)."""
+    bit-identical to its oracle).  ``--engine`` is wired through the
+    registry: any engine with a ``serve`` backend runs the same loop
+    (``--engine rle-lanes-mixed`` serves from the blocked O(NB+K)
+    kernels; the dedicated ``serve-lanes`` config additionally proves
+    flat-twin bit-identity and records the step-cost ratio)."""
     from text_crdt_rust_tpu.config import ServeConfig, engines_for
     from text_crdt_rust_tpu.serve.loadgen import ServeLoadGen
 
+    # Fall back to the ServeConfig default (flat, the measured
+    # reference backend) — NOT engines_for("serve")[0], which follows
+    # registry dict order and silently flipped when rle-lanes-mixed
+    # registered for serve.
     engine = args.engine if args.engine in engines_for("serve") \
-        else engines_for("serve")[0]
+        else ServeConfig().engine
     docs, ticks, events = (24, 10, 16) if args.smoke else (200, 60, 48)
     scfg = ServeConfig(engine=engine, num_shards=2, lanes_per_shard=16)
     gen = ServeLoadGen(docs=docs, agents_per_doc=3, ticks=ticks,
@@ -1154,11 +1162,63 @@ def cfg_serve(args):
         frames_rejected=srv.get("rejected_frame_rejected", 0),
         p50_admission_to_applied_us=report["latency_us"]["p50"],
         p99_admission_to_applied_us=report["latency_us"]["p99"],
+        tick_p50_ms=report["tick_ms"]["p50"],
+        tick_p99_ms=report["tick_ms"]["p99"],
         fault_rate=0.10, zipf_alpha=1.1,
         note="closed-loop serving: ops/s counts applied CRDT item-ops "
              "end-to-end through admission/causal-buffer/batch ticks, "
              "not raw kernel throughput; no equal-workload native "
              "baseline is defined for the serving loop")
+
+
+def cfg_serve_lanes(args):
+    """Config serve-lanes (ISSUE 4): the continuous-batching document
+    server on the BLOCKED ``rle-lanes-mixed`` lane backend, proven two
+    ways by ``perf/blocked_lanes_sim.py --serve`` in a subprocess (the
+    sp_bench pattern — the probe owns its own jax platform config):
+    bit-identity (the same seeded loadgen on the lanes backend AND a
+    flat-backend twin, every doc byte-identical across backends and to
+    the host oracles) and step cost (the loadgen tick trace replayed
+    through the kernel-exact blocked cost model vs the flat engine's
+    whole-[CAP]-plane model)."""
+    cmd = [sys.executable,
+           os.path.join("perf", "blocked_lanes_sim.py"), "--serve"]
+    if args.smoke:
+        cmd.append("--smoke")
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=5400)
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln]
+    if r.returncode not in (0, 1) or not lines:
+        tail = (r.stderr or "").strip().splitlines()[-3:]
+        raise RuntimeError(f"serve-lanes probe failed: {tail}")
+    out = json.loads(lines[-1])
+    rep = out["per_engine"]["rle-lanes-mixed"]
+    w = out["workload"]
+    # State bytes per lane: 2 run planes + 4 block tables + fwd + the
+    # 3 by-order tables (oll/orl/ordblk), i32 each; geometry comes from
+    # the probe's own workload report, not re-stated literals.
+    hbm = (w["num_shards"] * w["lanes_per_shard"]
+           * (2 * w["lane_capacity"] + 5 * w["NBT"]
+              + 3 * w["order_capacity"]) * 4)
+    ok = rep["converged"] and out["bit_identical_flat_vs_lanes"]
+    return make_row(
+        "config_serve_lanes_blocked_backend", "rle-lanes-mixed",
+        rep["item_ops_applied"], 1, rep["device_ticks_wall_s"],
+        max(rep["device_steps"], 1), hbm, None, ok,
+        docs=w["docs"], ticks=w["ticks"], block_k=w["block_k"],
+        nb=w["NB"], bit_identical_flat_twin=out[
+            "bit_identical_flat_vs_lanes"],
+        touched_rows_per_step_flat=out["touched_rows_per_step"]["flat"],
+        touched_rows_per_step_lanes=out["touched_rows_per_step"][
+            "lanes_blocked"],
+        touched_rows_ratio=out["touched_rows_per_step"]["ratio"],
+        pass_traffic_ratio=out["pass_traffic_per_step"]["ratio"],
+        splits=out["splits"], hint_misses=out["hint_misses"],
+        tick_p50_ms=rep["tick_ms"]["p50"],
+        tick_p99_ms=rep["tick_ms"]["p99"],
+        p50_admission_to_applied_us=rep["latency_us"]["p50"],
+        p99_admission_to_applied_us=rep["latency_us"]["p99"],
+        evictions=rep["evictions"], restores=rep["restores"],
+        note=out["note"])
 
 
 def cfg_sp(args):
@@ -1283,7 +1343,8 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="northstar",
                     choices=("northstar", "1", "2", "3", "4", "5", "5r",
-                             "kevin", "serve", "sp", "all"))
+                             "kevin", "serve", "serve-lanes", "sp",
+                             "all"))
     ap.add_argument("--trace", default="automerge-paper")
     ap.add_argument("--patches", type=int, default=0,
                     help="northstar trace prefix (0 = FULL trace)")
@@ -1345,6 +1406,7 @@ def main() -> None:
         "5r": cfg_5_remote,
         "kevin": cfg_kevin,
         "serve": cfg_serve,
+        "serve-lanes": cfg_serve_lanes,
         "sp": cfg_sp,
     }
     if args.config != "all":
@@ -1365,7 +1427,7 @@ def main() -> None:
     # three-rounds-missing kevin, the unverified-lever configs, and the
     # CPU-capable serve/sp/1 configs last (they need no TPU at all).
     for key in ("northstar", "kevin", "4", "5r", "5", "2", "3",
-                "serve", "sp", "1"):
+                "serve", "serve-lanes", "sp", "1"):
         if key in sink.done_keys:
             log(f"=== config {key} === (resumed from {args.out})")
             continue

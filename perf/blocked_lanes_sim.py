@@ -29,12 +29,25 @@ op's origin_right or has an earlier-positioned origin_left), so the
 scan cost is one probe — the same count the kernels pay on these
 streams.
 
-Run: python perf/blocked_lanes_sim.py [--docs N] [--block-k K]
+Round 7 (ISSUE 4) adds ``--serve``: replay the SERVE loadgen tick trace
+— the per-doc compiled streams the continuous batcher actually ships to
+the device, tapped via ``ContinuousBatcher.step_trace``, with per-lane
+sims re-seeded from the oracle at every residency upload exactly as
+``serve/lanes_backend.upload_lane`` re-seeds the device — through the
+same two cost models, plus the live acceptance proof: the
+``rle-lanes-mixed`` loadgen run must end bit-identical per doc to a
+``flat``-backend twin run of the same seed AND to the host oracles.
+Writes ``perf/serve_lanes_r7.json`` and prints one bench-row-ready JSON
+line (bench.py's ``serve-lanes`` config wraps it).
+
+Run: python perf/blocked_lanes_sim.py [--docs N] [--block-k K] [--serve]
 """
 import argparse
+import json
 import math
 import random
 import sys
+import time
 
 sys.path.insert(0, ".")
 
@@ -467,6 +480,195 @@ def config5_workload(docs, chunks, steps_per_chunk, block_k, remote):
     return c, caps
 
 
+def _seed_sim_from_oracle(sim: BlockedLaneSim, oracle) -> None:
+    """Re-seed a lane sim from a host oracle the way
+    ``serve/lanes_backend.upload_lane`` seeds the device: the SAME
+    packer call (``pack_lane_blocks`` owns the occupancy rule), its
+    run->block assignment expanded into the sim's block lists and warm
+    hints, forward pointers cleared."""
+    from text_crdt_rust_tpu.ops.lane_blocks import (
+        oracle_runs,
+        pack_lane_blocks,
+    )
+
+    starts, lens = oracle_runs(oracle)
+    nb = sim.cap // sim.K
+    _, run_block = pack_lane_blocks(starts, lens, K=sim.K, NB=nb,
+                                    NBT=max(8, nb), capacity=sim.cap)
+    nblocks = max(int(run_block[-1]) + 1, 1) if len(run_block) else 1
+    sim.blocks = [[] for _ in range(nblocks)]
+    sim.order = list(range(nblocks))
+    sim.hint = {}
+    sim.fwd = {}
+    for s, ln, b in zip(starts, lens, run_block):
+        o0 = int(abs(s)) - 1
+        sim.blocks[int(b)].append([o0, int(ln), bool(s > 0)])
+        for oo in range(o0, o0 + int(ln)):
+            sim.hint[oo] = int(b)
+
+
+def _replay_stream(sim: BlockedLaneSim, unb: UnblockedCost, c: Counter,
+                   ops) -> None:
+    """One per-doc compiled tick stream through both cost models (the
+    config5_workload inner loop, unbatched [S] columns)."""
+    import numpy as np
+
+    kind = np.asarray(ops.kind)
+    pos = np.asarray(ops.pos)
+    dln = np.asarray(ops.del_len)
+    dtg = np.asarray(ops.del_target)
+    olp = np.asarray(ops.origin_left).astype(np.int64)
+    iln = np.asarray(ops.ins_len)
+    stt = np.asarray(ops.ins_order_start)
+    for s in range(ops.num_steps):
+        k, p, dl, il = (int(kind[s]), int(pos[s]), int(dln[s]),
+                        int(iln[s]))
+        st = int(stt[s])
+        if k == 0 and dl:
+            c.steps += 1
+            unb.local_delete(c)
+            sim.begin_step(); sim.delete_local(p, dl); sim.end_step()
+        if k == 0 and il:
+            c.steps += 1
+            unb.local_insert(c)
+            sim.begin_step(); sim.insert_local(p, il, st); sim.end_step()
+        if k == 1 and il:
+            c.steps += 1
+            unb.remote_insert(c, sim.ocap)
+            ol = None if olp[s] == 0xFFFFFFFF else int(olp[s])
+            sim.begin_step(); sim.remote_insert(ol, il, st); sim.end_step()
+        if k == 2 and dl:
+            c.steps += 1
+            unb.remote_delete(c)
+            sim.begin_step(); sim.remote_delete(int(dtg[s]), dl); sim.end_step()
+
+
+def serve_workload(smoke: bool = False):
+    """The ISSUE-4 acceptance + perf probe: run the seeded serve
+    loadgen on BOTH lane backends (bit-identity proof), replaying the
+    lanes run's tick trace through the kernel-exact blocked cost model
+    and the flat engine's whole-[CAP]-plane-per-step model.
+
+    The flat serve engine (`ops/flat.py`) splices the whole [CAP] char
+    plane per step exactly like the un-blocked lanes kernels splice
+    their [CAP] run plane, so ``UnblockedCost`` doubles as its
+    touched-rows model (CAP = the serve lane capacity).  Both models
+    assume shallow YATA scans (serve edits are small and conflicts
+    rare); splice/locate/split costs are kernel-exact.
+    """
+    from text_crdt_rust_tpu.config import ServeConfig, lane_block_geometry
+    from text_crdt_rust_tpu.serve.loadgen import ServeLoadGen
+
+    docs, ticks, events = (24, 10, 16) if smoke else (200, 60, 48)
+    base = ServeConfig()
+    K = base.lanes_block_k
+    cap_runs, NB, NBT = lane_block_geometry(base.lane_capacity, K)
+    OCAP = base.order_capacity
+    c = Counter()
+    unb = UnblockedCost(base.lane_capacity)
+    sims = {}
+    reports = {}
+    strings = {}
+    shapes = None
+
+    for engine in ("rle-lanes-mixed", "flat"):
+        scfg = ServeConfig(engine=engine, num_shards=2,
+                           lanes_per_shard=16)
+        gen = ServeLoadGen(docs=docs, agents_per_doc=3, ticks=ticks,
+                           events_per_tick=events, zipf_alpha=1.1,
+                           fault_rate=0.10, local_prob=0.25, seed=7,
+                           cfg=scfg)
+        if engine == "rle-lanes-mixed":
+            # Tap every compiled per-doc tick stream; re-seed the doc's
+            # sim at every residency upload (the device does the same).
+            res = gen.server.residency
+
+            def trace(doc_id, ops):
+                sim = sims.get(doc_id)
+                if sim is None:
+                    sim = sims[doc_id] = BlockedLaneSim(
+                        K, cap_runs, c, OCAP)
+                _replay_stream(sim, unb, c, ops)
+
+            gen.server.batcher.step_trace = trace
+            for si, backend in enumerate(res.backends):
+                def wrap(orig, si):
+                    def upload(b, oracle, ranks):
+                        doc_id = res.lane_owner[si][b]
+                        sim = sims.get(doc_id)
+                        if sim is None:
+                            sim = sims[doc_id] = BlockedLaneSim(
+                                K, cap_runs, c, OCAP)
+                        _seed_sim_from_oracle(sim, oracle)
+                        orig(b, oracle, ranks)
+                    return upload
+                backend.upload_lane = wrap(backend.upload_lane, si)
+        t0 = time.perf_counter()
+        report = gen.run()
+        report["probe_wall_s"] = round(time.perf_counter() - t0, 3)
+        assert report["converged"], (engine, report["mismatches"][:4])
+        reports[engine] = report
+        strings[engine] = {w.doc_id: gen.server.doc_string(w.doc_id)
+                           for w in gen.worlds}
+        if engine == "rle-lanes-mixed":
+            shapes = sorted(set().union(
+                *(b.shapes_seen
+                  for b in gen.server.residency.backends)))
+
+    bit_identical = strings["rle-lanes-mixed"] == strings["flat"]
+    tr = c.unb_touched / max(c.blk_touched, 1)
+    pr = c.unb_traffic / max(c.blk_traffic, 1)
+    out = {
+        "workload": {
+            "docs": docs, "agents_per_doc": 3, "ticks": ticks,
+            "events_per_tick": events, "fault_rate": 0.10,
+            "zipf_alpha": 1.1, "seed": 7,
+            "num_shards": 2, "lanes_per_shard": 16,
+            "lane_capacity": base.lane_capacity,
+            "block_k": K, "NB": NB, "NBT": NBT,
+            "order_capacity": OCAP,
+        },
+        "bit_identical_flat_vs_lanes": bit_identical,
+        "trace_steps": c.steps,
+        "splits": c.splits,
+        "hint_misses": c.hint_misses,
+        "hint_probes": c.hint_probes,
+        "touched_rows_per_step": {
+            "flat": round(c.unb_touched / max(c.steps, 1), 1),
+            "lanes_blocked": round(c.blk_touched / max(c.steps, 1), 1),
+            "ratio": round(tr, 2),
+        },
+        "pass_traffic_per_step": {
+            "flat": round(c.unb_traffic / max(c.steps, 1), 1),
+            "lanes_blocked": round(c.blk_traffic / max(c.steps, 1), 1),
+            "ratio": round(pr, 2),
+        },
+        "lanes_shapes_seen": shapes,
+        "per_engine": {
+            eng: {
+                "converged": r["converged"],
+                "item_ops_applied": r["item_ops_applied"],
+                "device_steps": r["server"].get("device_steps", 0),
+                "device_ticks_wall_s": r["device_ticks_wall_s"],
+                "tick_ms": r["tick_ms"],
+                "latency_us": r["latency_us"],
+                "evictions": r["server"].get("evictions", 0),
+                "restores": r["server"].get("restores", 0),
+                "docs_degraded": r["server"].get("docs_degraded", 0),
+            }
+            for eng, r in reports.items()
+        },
+        "note": "CPU run: the lanes backend executes the real blocked "
+                "kernel via the pallas interpreter (jitted to XLA "
+                "CPU), so tick latencies are NOT silicon numbers; "
+                "touched-rows/pass-traffic come from the kernel-exact "
+                "step-cost replay of the lanes run's tick trace "
+                "(shallow-YATA-scan model). Re-record on silicon via "
+                "perf/when_up_r7.sh.",
+    }
+    return out
+
+
 def report(name, c: Counter, caps):
     tr = c.unb_touched / max(c.blk_touched, 1)
     pr = c.unb_traffic / max(c.blk_traffic, 1)
@@ -490,7 +692,30 @@ def main():
     ap.add_argument("--chunks", type=int, default=8)
     ap.add_argument("--steps", type=int, default=100)
     ap.add_argument("--block-k", type=int, default=32)
+    ap.add_argument("--serve", action="store_true",
+                    help="replay the serve loadgen tick trace instead "
+                         "of configs 5/5r (ISSUE 4); writes "
+                         "perf/serve_lanes_r7.json")
+    ap.add_argument("--smoke", action="store_true",
+                    help="with --serve: tiny workload (CI)")
+    ap.add_argument("--out", default="perf/serve_lanes_r7.json")
     args = ap.parse_args()
+    if args.serve:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        out = serve_workload(smoke=args.smoke)
+        if not args.smoke:
+            with open(args.out, "w") as f:
+                json.dump(out, f, indent=1)
+            print(f"wrote {args.out}", file=sys.stderr)
+        print(json.dumps(out))
+        ratio = out["touched_rows_per_step"]["ratio"]
+        ok = out["bit_identical_flat_vs_lanes"] and ratio >= 5
+        print(f"acceptance (bit-identical + >=5x touched-rows): "
+              f"{'PASS' if ok else 'FAIL'} (ratio {ratio}x)",
+              file=sys.stderr)
+        return 0 if ok else 1
     c5, caps5 = config5_workload(args.docs, args.chunks, args.steps,
                                  args.block_k, remote=False)
     t5, _ = report("config 5  (local lanes)", c5, caps5)

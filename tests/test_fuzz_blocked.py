@@ -109,8 +109,12 @@ class TestLanesMixedFuzz:
             _one_round(seed)
 
     @pytest.mark.slow
-    def test_300_more_seeds(self):
-        for seed in range(60, 360):
+    def test_1000_more_seeds(self):
+        """Deep-fuzz volume (ROADMAP #6): spend the x27.5 oracle-index
+        speedup — 1,000+ hard-mode seeds for this surface per round
+        (tier-1 keeps its 60-seed budget; the shared fixed device shape
+        means the whole sweep reuses one compiled trace per engine)."""
+        for seed in range(60, 1060):
             _one_round(seed)
 
 
@@ -146,4 +150,11 @@ class TestSpRemoteRideAlong:
 
     def test_50_seeds(self):
         for seed in range(40_000, 40_050):
+            self._round(seed)
+
+    @pytest.mark.slow
+    def test_1000_more_seeds(self):
+        """Deep-fuzz volume (ROADMAP #6) for the sp-remote surface:
+        1,000 further seeds in the slow tier."""
+        for seed in range(40_050, 41_050):
             self._round(seed)

@@ -127,6 +127,39 @@ def test_agent_onboarding_rebases_lane_ranks():
     assert srv.doc_string("d") == twin.to_string()
 
 
+def test_same_tick_onboarding_defers_epoch_boundary():
+    """An agent-onboarding event queued BEHIND an old-agent edit in the
+    same tick must not share that tick's compiled stream: the remap
+    rewrites the lane's persisted ranks to the new epoch, but the
+    already-compiled steps baked the old ranks in — prefiling them
+    after the remap plants stale ranks under later same-origin
+    tiebreaks (the latent divergence ISSUE 4's twin runs exposed).
+    The batcher defers the onboarding event one tick instead."""
+    srv = DocServer(cfg())
+    srv.admit_doc("d")
+    srv.submit_local("d", "mmm", 0, ins_content="base")
+    srv.tick()
+    # Same tick: an old-epoch edit ahead of a new agent's txn.
+    srv.submit_local("d", "mmm", 0, ins_content="pre")
+    t_a = RemoteTxn(id=RemoteId("aaa", 0), parents=[ROOT],
+                    ops=[RemoteIns(ROOT, ROOT, "A")])
+    srv.submit_txn("d", t_a)
+    srv.tick()
+    assert srv.counters.get("epoch_boundary_deferrals") >= 1
+    # The deferred event lands next tick, in its own epoch.
+    t_z = RemoteTxn(id=RemoteId("zzz", 0), parents=[ROOT],
+                    ops=[RemoteIns(ROOT, ROOT, "Z")])
+    srv.submit_txn("d", t_z)
+    srv.submit_local("d", "mmm", 0, ins_content="x")
+    srv.drain()
+    assert_lanes_equal_oracles(srv)
+    twin = ListCRDT()
+    doc = srv.doc_state("d")
+    for t in export_txns_since(doc.oracle, 0):
+        twin.apply_remote_txn(t)
+    assert srv.doc_string("d") == twin.to_string()
+
+
 def test_oracle_signed_encoding():
     doc = ListCRDT()
     a = doc.get_or_create_agent_id("a")

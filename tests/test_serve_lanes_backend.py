@@ -1,0 +1,292 @@
+"""serve/lanes_backend.py: the blocked O(NB+K) lanes engine behind the
+serve LaneBackend surface — persistent per-tick state, per-lane
+residency writes, rank remap on blocked state, run-row capacity
+degradation (ISSUE 4 tentpole).
+
+Every test shares ONE kernel geometry (lanes=4, capacity=128, K=8,
+OCAP=512, buckets (8, 32)) so the whole file pays two kernel compiles,
+not two per test.
+"""
+import numpy as np
+import pytest
+
+from text_crdt_rust_tpu.common import RemoteId, RemoteIns, RemoteTxn
+from text_crdt_rust_tpu.config import ServeConfig, engines_for
+from text_crdt_rust_tpu.models.oracle import ListCRDT
+from text_crdt_rust_tpu.models.sync import export_txns_since, state_digest
+from text_crdt_rust_tpu.serve.batcher import make_lane_backend, oracle_signed
+from text_crdt_rust_tpu.serve.lanes_backend import LanesMixedLaneBackend
+from text_crdt_rust_tpu.serve.server import DocServer
+
+ROOT = RemoteId("ROOT", 0xFFFFFFFF)
+
+
+def cfg(**kw):
+    base = dict(engine="rle-lanes-mixed", num_shards=1, lanes_per_shard=4,
+                lane_capacity=128, lanes_block_k=8, order_capacity=512,
+                step_buckets=(8, 32), max_txn_len=32)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def assert_lanes_equal_oracles(srv):
+    for doc_id in srv.router.docs:
+        assert srv.verify_doc(doc_id), f"{doc_id}: lane != oracle"
+
+
+def test_registry_dispatch_builds_lanes_backend():
+    assert "rle-lanes-mixed" in engines_for("serve")
+    b = make_lane_backend("rle-lanes-mixed", lanes=4, capacity=128,
+                          order_capacity=512, lmax=4, block_k=8)
+    assert isinstance(b, LanesMixedLaneBackend)
+    assert b.engine == "rle-lanes-mixed"
+    assert b.NB == 16 and b.block_k == 8
+    # The flat path still dispatches through the registry too.
+    f = make_lane_backend("flat", lanes=4, capacity=128,
+                          order_capacity=512, lmax=4)
+    assert f.engine == "flat"
+
+
+def test_mixed_local_remote_ticks_lane_equals_oracle():
+    """The flat batcher test, engine-swapped: per-tick staged local AND
+    remote ops on the blocked backend stay bit-identical to the host
+    oracles across every tick."""
+    srv = DocServer(cfg())
+    for i in range(3):
+        srv.admit_doc(f"d{i}")
+    peer = ListCRDT()
+    pa = peer.get_or_create_agent_id("peer")
+    mark = 0
+    for step in range(6):
+        for i in range(3):
+            srv.submit_local(f"d{i}", "ed", 0, ins_content=f"s{step}")
+        peer.local_insert(pa, len(peer), "pq")
+        if step % 2:
+            peer.local_delete(pa, 0, 1)
+        for t in export_txns_since(peer, mark):
+            srv.submit_txn("d0", t)
+        mark = peer.get_next_order()
+        srv.tick()
+        assert_lanes_equal_oracles(srv)
+
+
+def test_tick_shapes_are_bucketed_no_recompile_growth():
+    """Steady-state serving cycles a fixed set of compiled shapes: the
+    blocked backend sees at most one shape per configured step bucket,
+    exactly as the flat backend asserts."""
+    srv = DocServer(cfg())
+    srv.admit_doc("d")
+    rng = np.random.RandomState(0)
+    for _tick in range(10):
+        for _ in range(int(rng.randint(1, 6))):
+            srv.submit_local("d", "ed", 0, ins_content="ab")
+        srv.tick()
+    seen = srv.residency.backends[0].shapes_seen
+    assert seen <= {8, 32}, seen
+    assert_lanes_equal_oracles(srv)
+
+
+def test_evict_restore_replay_matches_resident_twin(tmp_path):
+    """The residency invariant on the lanes backend: evict mid-stream,
+    peers keep editing while the doc is out, a touch restores (the
+    per-lane blocked seeding path) and replays — bit-identical to an
+    always-resident twin server, device lane included."""
+    src = ListCRDT()
+    a = src.get_or_create_agent_id("amy")
+    mark = 0
+    chunks = []
+    for i in range(8):
+        src.local_insert(a, len(src) // 2, f"<{i}>")
+        if i % 3 == 2 and len(src) > 4:
+            src.local_delete(a, 1, 2)
+        chunks.append(export_txns_since(src, mark))
+        mark = src.get_next_order()
+
+    srv = DocServer(cfg(spool_dir=str(tmp_path / "a")))
+    twin = DocServer(cfg(spool_dir=str(tmp_path / "b")))
+    for s in (srv, twin):
+        s.admit_doc("d")
+    for chunk in chunks[:4]:
+        for t in chunk:
+            srv.submit_txn("d", t)
+            twin.submit_txn("d", t)
+        srv.tick(); twin.tick()
+    doc = srv.doc_state("d")
+    assert doc.in_lane
+    srv.residency.evict(doc)
+    for chunk in chunks[4:]:
+        for t in chunk:
+            srv.submit_txn("d", t)
+            twin.submit_txn("d", t)
+        twin.tick()
+    assert doc.evicted and len(doc.events) > 0
+    srv.tick()
+    assert doc.resident and not doc.evicted
+    srv.drain(); twin.drain()
+    assert srv.doc_string("d") == src.to_string()
+    assert srv.doc_string("d") == twin.doc_string("d")
+    assert (state_digest(doc.oracle)
+            == state_digest(twin.doc_state("d").oracle))
+    assert srv.verify_doc("d") and twin.verify_doc("d")
+
+
+def _fragment(srv, doc_id, edits=14):
+    """Drive single-char prepends (each its own run — no merge) so the
+    lane's blocks SPLIT and the split forward pointers arm."""
+    for i in range(edits):
+        srv.submit_local(doc_id, "ed", 0, ins_content="abcdefgh"[i % 8])
+        srv.tick()
+
+
+def test_remap_on_lane_with_split_forward_pointers():
+    """The PR 2 self-healing path under an epoch re-base: fragment one
+    lane until its blocks split (fwd pointers armed, hint entries going
+    stale), onboard a new agent (rank remap on the blocked state), then
+    land concurrent same-origin inserts whose tiebreak reads the
+    remapped ranks through hint-guided probes."""
+    srv = DocServer(cfg())
+    srv.admit_doc("d")
+    # 'mmm' writes first; rank(mmm)=0 accumulates in the lane's table.
+    srv.submit_local("d", "mmm", 0, ins_content="base")
+    srv.tick()
+    _fragment(srv, "d")
+    backend = srv.residency.backends[0]
+    doc = srv.doc_state("d")
+    assert doc.in_lane
+    fwd = np.asarray(backend._state[10])[:, doc.lane]
+    assert (fwd >= 0).any(), "no block ever split — workload too small"
+    # 'aaa' joins: sorted ranks shift; the lane's accumulated rank table
+    # must re-base before the tiebreaks below read it.
+    t_a = RemoteTxn(id=RemoteId("aaa", 0), parents=[ROOT],
+                    ops=[RemoteIns(ROOT, ROOT, "A")])
+    t_z = RemoteTxn(id=RemoteId("zzz", 0), parents=[ROOT],
+                    ops=[RemoteIns(ROOT, ROOT, "Z")])
+    srv.submit_txn("d", t_a)
+    srv.tick()
+    assert srv.counters.get("lane_rank_remaps") >= 1
+    srv.submit_txn("d", t_z)
+    srv.submit_local("d", "mmm", 0, ins_content="x")
+    srv.tick()
+    assert_lanes_equal_oracles(srv)
+    # Cross-check against a one-shot oracle replay of the same history.
+    twin = ListCRDT()
+    for t in export_txns_since(srv.doc_state("d").oracle, 0):
+        twin.apply_remote_txn(t)
+    assert srv.doc_string("d") == twin.to_string()
+
+
+def test_evict_restore_after_splits_reseeds_bit_identical(tmp_path):
+    """Upload-path seeding of a lane whose pre-eviction device state
+    had split blocks and stale hints: the reseeded packed state must
+    read back bit-identical to the oracle."""
+    srv = DocServer(cfg(spool_dir=str(tmp_path)))
+    srv.admit_doc("d")
+    _fragment(srv, "d", edits=12)
+    doc = srv.doc_state("d")
+    pre_evict = oracle_signed(doc.oracle)
+    srv.residency.evict(doc)
+    srv.submit_local("d", "ed", 0, ins_content="Z")
+    srv.tick()
+    assert doc.resident and doc.in_lane
+    got = srv.residency.backends[0].lane_signed(doc.lane)
+    assert np.array_equal(got, oracle_signed(doc.oracle))
+    # The reseeded body is the pre-eviction body plus the one prepended
+    # char (same chars, shifted one position right).
+    assert len(got) == len(pre_evict) + 1
+    assert np.array_equal(got[1:], pre_evict)
+    assert srv.verify_doc("d")
+
+
+def test_run_row_overflow_degrades_to_host_oracle():
+    """A doc whose RUN-ROW count outgrows the blocked lane budget keeps
+    serving from the host oracle: lane freed, no assert, content still
+    converges (the flat overflow contract, run-row unit)."""
+    srv = DocServer(cfg(max_queue_per_doc=512))
+    srv.admit_doc("d")
+    backend = srv.residency.backends[0]
+    budget = backend.row_budget
+    assert budget > 0
+    # Single-char prepends never merge: run rows == edits.
+    for i in range(budget + 6):
+        srv.submit_local("d", "ed", 0, ins_content="x")
+        if i % 8 == 7:
+            srv.tick()
+    srv.drain(max_ticks=128)
+    doc = srv.doc_state("d")
+    assert doc.degraded and not doc.in_lane
+    assert srv.counters.get("lane_overflow_degraded") >= 1
+    assert len(srv.doc_string("d")) == budget + 6
+    srv.submit_local("d", "ed", 0, ins_content="tail")
+    srv.tick()
+    assert srv.doc_string("d").startswith("tail")
+
+
+def test_replace_step_growth_counts_both_branches():
+    """A compiled local REPLACE step carries a delete AND an insert in
+    ONE device step; each active branch can splice +2 rows, so the
+    capacity probes must budget 4 for it — a 2/step bound would make
+    the kernel's out-of-blocks flag reachable from ``submit_local``."""
+    from text_crdt_rust_tpu.ops import batch as B
+    from text_crdt_rust_tpu.utils.testdata import TestPatch
+
+    backend = make_lane_backend("rle-lanes-mixed", lanes=2, capacity=128,
+                                order_capacity=512, lmax=4, block_k=8)
+    ops, _ = B.compile_local_patches([TestPatch(2, 3, "xy")], lmax=4,
+                                     start_order=10)
+    assert ops.num_steps == 1
+    assert int(backend._stream_growth(ops.del_len, ops.ins_len)) == 4
+    # And end-to-end: replace edits through the serve surface stay
+    # bit-identical on the lanes backend.
+    srv = DocServer(cfg())
+    srv.admit_doc("d")
+    srv.submit_local("d", "ed", 0, ins_content="abcdefgh")
+    srv.tick()
+    srv.submit_local("d", "ed", 2, del_len=3, ins_content="XY")
+    srv.submit_local("d", "ed", 0, del_len=1, ins_content="z")
+    srv.drain()
+    assert srv.doc_string("d") == "zbXYfgh"
+    assert_lanes_equal_oracles(srv)
+
+
+def test_small_loadgen_on_lanes_backend_converges():
+    """A compressed closed loop (faults + forced evictions) on the
+    lanes backend: every doc bit-identical to its twin, every lane to
+    its oracle. The full 200-doc acceptance shape runs in ``slow``."""
+    from text_crdt_rust_tpu.serve.loadgen import ServeLoadGen
+
+    gen = ServeLoadGen(docs=10, agents_per_doc=2, ticks=8,
+                       events_per_tick=10, zipf_alpha=1.1,
+                       fault_rate=0.10, local_prob=0.25, seed=11,
+                       cfg=cfg(lanes_per_shard=4))
+    report = gen.run()
+    assert report["converged"], report["mismatches"]
+    assert report["server"]["evictions"] >= 1
+    assert report["tick_ms"]["samples"] > 0
+
+
+@pytest.mark.slow
+def test_loadgen_acceptance_shape_lanes_vs_flat_twin():
+    """The ISSUE-4 acceptance run: 200 docs x 3 agents, 10% per-class
+    faults, evictions forced — on the lanes backend, bit-identical
+    per doc to a FlatLaneBackend twin run of the same seed AND to the
+    host oracles, with shapes_seen bounded by the step buckets."""
+    from text_crdt_rust_tpu.serve.loadgen import ServeLoadGen
+
+    reports = {}
+    strings = {}
+    for engine in ("rle-lanes-mixed", "flat"):
+        scfg = ServeConfig(engine=engine, num_shards=2, lanes_per_shard=16)
+        gen = ServeLoadGen(docs=200, agents_per_doc=3, ticks=60,
+                           events_per_tick=48, zipf_alpha=1.1,
+                           fault_rate=0.10, local_prob=0.25, seed=7,
+                           cfg=scfg)
+        reports[engine] = gen.run()
+        assert reports[engine]["converged"], reports[engine]["mismatches"]
+        assert reports[engine]["server"]["evictions"] >= 20
+        strings[engine] = {w.doc_id: gen.server.doc_string(w.doc_id)
+                           for w in gen.worlds}
+        if engine == "rle-lanes-mixed":
+            for b in gen.server.residency.backends:
+                assert b.shapes_seen <= set(scfg.step_buckets), \
+                    b.shapes_seen
+    assert strings["rle-lanes-mixed"] == strings["flat"]

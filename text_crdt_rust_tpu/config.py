@@ -25,16 +25,23 @@ ENGINE_REGISTRY = {
     "rle-hbm":         {"module": "ops.rle_hbm", "configs": ("northstar", "kevin")},
     "rle-lanes":       {"module": "ops.rle_lanes", "configs": ("5",)},
     "rle-mixed":       {"module": "ops.rle_mixed", "configs": ("4",)},
-    "rle-lanes-mixed": {"module": "ops.rle_lanes_mixed", "configs": ("5r",)},
+    # The blocked per-lane mixed engine serves two surfaces: the config
+    # 5r streaming replay AND the document server's lane backend
+    # (serve/lanes_backend.py carries the blocked state across ticks).
+    "rle-lanes-mixed": {"module": "ops.rle_lanes_mixed",
+                        "configs": ("5r", "serve", "serve-lanes"),
+                        "serve_backend":
+                            "serve.lanes_backend:LanesMixedLaneBackend"},
     "blocked":         {"module": "ops.blocked", "configs": ("northstar",)},
     "blocked-mixed":   {"module": "ops.blocked_mixed", "configs": ("4",)},
     "hbm":             {"module": "ops.blocked_hbm", "configs": ("northstar",)},
-    # The serve batcher's device backend: the vmapped flat engine is the
-    # one whose incremental batched-apply surface (ops.flat.apply_ops_batch
-    # + per-lane upload/clear) the document server consumes today; the
-    # blocked lanes engines plug in behind the same LaneBackend interface
-    # once they grow per-tick staged-op application (serve/batcher.py).
-    "flat":            {"module": "ops.flat", "configs": ("serve",)},
+    # The serve batcher's device backends: ``serve_backend`` names the
+    # LaneBackend class `serve.batcher.make_lane_backend` constructs —
+    # registry-driven dispatch, no hardcoded engine asserts.  The
+    # vmapped flat engine is the measured default; rle-lanes-mixed runs
+    # the same serve surface at O(NB+K) touched rows/step.
+    "flat":            {"module": "ops.flat", "configs": ("serve",),
+                        "serve_backend": "serve.batcher:FlatLaneBackend"},
     # One huge doc sharded over the sp axis (bench --config sp).
     "sp-apply":        {"module": "parallel.sp_apply", "configs": ("sp",)},
 }
@@ -134,8 +141,16 @@ class ServeConfig:
     engine: str = "flat"       # registry engine backing the lane batches
     num_shards: int = 2        # device batches (one [B, CAP] doc batch each)
     lanes_per_shard: int = 16  # B — docs resident per shard batch
-    lane_capacity: int = 512   # CAP — body rows per lane
+    lane_capacity: int = 512   # CAP — body rows per lane (flat: chars;
+    #                            rle-lanes-mixed: RUN rows)
     order_capacity: int = 1536 # OCAP — by-order log rows per lane
+    lanes_block_k: int = 32    # K (rows per block) for the blocked
+    #                            rle-lanes-mixed backend; smaller K than
+    #                            the config-5/5r replays because serve
+    #                            steps are tiny edits and NBT+K is the
+    #                            per-step touched-row floor (PERF.md §10)
+    interpret: Optional[bool] = None  # pallas interpreter for the lanes
+    #                            backend (None = auto: on unless on TPU)
     lmax: int = 8              # insert-chunk width of compiled serve steps
     step_buckets: tuple = (8, 32, 128)  # padded tick step shapes; a tick
     #                            drains at most step_buckets[-1] compiled

@@ -7,6 +7,8 @@ documents onto B-lane device batches:
 - ``admission``  — typed backpressure (bounded queues, token buckets);
 - ``router``     — doc_id -> (shard, lane) + frames -> causal queues;
 - ``batcher``    — per-tick drain -> bucketed [S, B] device pass;
+- ``lanes_backend`` — the blocked O(NB+K) lane backend
+  (``rle-lanes-mixed``; the flat backend lives in ``batcher``);
 - ``residency``  — LRU lanes, checkpoint evict / restore;
 - ``server``     — the ``DocServer`` facade;
 - ``loadgen``    — deterministic closed-loop load generator + checker.
@@ -17,6 +19,10 @@ from .admission import (  # noqa: F401
     TokenBucket,
 )
 from .batcher import ContinuousBatcher, make_lane_backend  # noqa: F401
+# NOTE: serve.lanes_backend is deliberately NOT re-exported here — it
+# pulls in the pallas blocked kernels at import time, and
+# make_lane_backend resolves it lazily through the registry's
+# serve_backend entry only when the engine is actually selected.
 from .residency import LaneResidency  # noqa: F401
 from .router import DocState, ShardRouter  # noqa: F401
 from .server import DocServer  # noqa: F401

@@ -66,9 +66,13 @@ class FlatLaneBackend:
     engine = "flat"
 
     def __init__(self, lanes: int, capacity: int, order_capacity: int,
-                 lmax: int):
+                 lmax: int, block_k: Optional[int] = None,
+                 interpret: Optional[bool] = None):
         import jax.numpy as jnp
 
+        # block_k / interpret are lane-backend-constructor surface (the
+        # blocked backend consumes them); the flat engine has no blocks
+        # and is plain jax.numpy, so both are accepted and ignored.
         self.lanes = lanes
         self.capacity = capacity
         self.order_capacity = order_capacity
@@ -85,6 +89,22 @@ class FlatLaneBackend:
         (with the engine's lmax log-write headroom)?"""
         return (n <= self.capacity
                 and next_order <= self.order_capacity - self.lmax)
+
+    def fits_doc(self, oracle) -> bool:
+        """Residency-path probe (upload/restore): for the flat engine
+        the doc's char count IS its device occupancy, so this is
+        ``fits`` verbatim.  Backends with a different state unit (run
+        rows for the blocked lanes engine) override with an exact
+        answer derived from the oracle."""
+        return self.fits(oracle.n, oracle.get_next_order())
+
+    def tick_fits(self, b: int, oracle, stream) -> bool:
+        """Pre-apply probe for lane ``b``'s compiled tick ``stream``.
+        The oracle already applied (it is truth), so for the flat
+        engine its post-apply counts are exactly the post-tick device
+        occupancy — lane and stream don't matter.  Run-row backends
+        bound the stream's splice growth per active op branch."""
+        return self.fits(oracle.n, oracle.get_next_order())
 
     def clear_lane(self, b: int) -> None:
         self.docs = jax.tree.map(
@@ -135,12 +155,17 @@ class FlatLaneBackend:
 
 
 def make_lane_backend(engine: str, *, lanes: int, capacity: int,
-                      order_capacity: int, lmax: int):
-    """Registry-validated lane-backend construction. ``engine`` must be
-    registered for the ``serve`` config in ``config.ENGINE_REGISTRY``;
+                      order_capacity: int, lmax: int,
+                      block_k: int = 32,
+                      interpret: Optional[bool] = None):
+    """Registry-driven lane-backend construction: ``engine`` must be
+    registered for the ``serve`` config in ``config.ENGINE_REGISTRY``
+    AND carry a ``serve_backend`` entry naming its backend class —
     unknown or serve-less engines raise a precise ``ValueError`` at
     construction time (config-time strictness — runtime failures
     degrade, construction failures explain)."""
+    import importlib
+
     from ..config import ENGINE_REGISTRY, engines_for
 
     serve_engines = engines_for("serve")
@@ -148,12 +173,17 @@ def make_lane_backend(engine: str, *, lanes: int, capacity: int,
         raise ValueError(
             f"unknown engine {engine!r} (registry: "
             f"{tuple(ENGINE_REGISTRY)})")
-    if engine not in serve_engines:
+    target = ENGINE_REGISTRY[engine].get("serve_backend")
+    if engine not in serve_engines or not target:
         raise ValueError(
             f"engine {engine!r} has no serve lane backend; registered "
             f"serve engines: {serve_engines}")
-    assert engine == "flat", engine
-    return FlatLaneBackend(lanes, capacity, order_capacity, lmax)
+    mod_path, cls_name = target.split(":")
+    cls = getattr(importlib.import_module(
+        f"text_crdt_rust_tpu.{mod_path}"), cls_name)
+    return cls(lanes=lanes, capacity=capacity,
+               order_capacity=order_capacity, lmax=lmax,
+               block_k=block_k, interpret=interpret)
 
 
 def oracle_signed(oracle) -> np.ndarray:
@@ -219,6 +249,12 @@ class ContinuousBatcher:
         self.lmax = lmax
         self.counters = counters if counters is not None else Counters()
         self.latency_samples: List[float] = []
+        self.tick_wall_samples: List[float] = []  # per-tick wall seconds
+        # Optional per-doc compiled-stream tap: called as
+        # (doc_id, OpTensors) for every lane doc's tick stream BEFORE
+        # padding/stacking — how perf/blocked_lanes_sim.py replays the
+        # loadgen tick trace through its step-cost models.
+        self.step_trace = None
 
     def bucket(self, steps: int) -> int:
         for b in self.step_buckets:
@@ -300,6 +336,16 @@ class ContinuousBatcher:
             dmax=None)
         return True, ops
 
+    @staticmethod
+    def _new_agent_names(doc: DocState, event: Event) -> List[str]:
+        """Agent names this event would onboard into the doc's table."""
+        if event.kind == EV_LOCAL:
+            agent = event.payload[0]
+            return [] if (agent == "ROOT" or agent in doc.table) \
+                else [agent]
+        return [n for n in ShardRouter.txn_agent_names(event.payload)
+                if n not in doc.table]
+
     def _drain_doc(self, doc: DocState, budget: int, compile_device: bool
                    ) -> Tuple[Optional[B.OpTensors], List[Event], int]:
         """Drain up to ``budget`` compiled steps of FIFO events from one
@@ -314,6 +360,26 @@ class ContinuousBatcher:
             event = doc.events[0]
             est = estimate_steps(doc, event, self.lmax)
             if steps + est > budget:
+                break
+            if applied and self._new_agent_names(doc, event):
+                # Agent onboarding is an EPOCH BOUNDARY: the rank remap
+                # rewrites the lane's persisted by-order ranks, but the
+                # steps already compiled this tick baked the OLD ranks
+                # in — applying both in one stream would prefill stale
+                # ranks over the re-based log and diverge later
+                # same-origin tiebreaks.  Defer the onboarding event to
+                # the next tick so every compiled tick stream is
+                # single-epoch (FIFO preserved; one tick of latency).
+                # Gated on APPLIED (not compiled streams): host-only
+                # docs must defer on the same schedule, or the apply
+                # timing — and with it the interleaving of tick-end
+                # causal releases vs later local edits — would depend
+                # on the doc's lane status, which differs across lane
+                # backends (degradation thresholds differ) and would
+                # break the cross-backend bit-identity contract.  For
+                # lane docs the two conditions coincide (every applied
+                # event compiles >= 1 step).
+                self.counters.incr("epoch_boundary_deferrals")
                 break
             doc.events.popleft()
             self.router.admission.dequeued()
@@ -373,8 +439,11 @@ class ContinuousBatcher:
                     # Lane-capacity probe AFTER the oracle applied (the
                     # oracle is truth): overflow degrades to host-only,
                     # frees the lane, skips the device — never asserts.
-                    if backend.fits(doc.oracle.n,
-                                    doc.oracle.get_next_order()):
+                    # Backends define their own unit (chars for flat,
+                    # run rows + split headroom for the blocked lanes).
+                    if backend.tick_fits(doc.lane, doc.oracle, stream):
+                        if self.step_trace is not None:
+                            self.step_trace(doc.doc_id, stream)
                         lane_streams[doc.lane] = stream
                         stats["steps"] += stream.num_steps
                     else:
@@ -420,4 +489,5 @@ class ContinuousBatcher:
                 if released:
                     self.router.enqueue_released(doc, released)
         stats["tick_wall_s"] = now - t0
+        self.tick_wall_samples.append(stats["tick_wall_s"])
         return stats

@@ -1,0 +1,303 @@
+"""Serve lane backend over the BLOCKED streaming-lanes mixed engine
+(`ops/rle_lanes_mixed.make_replayer_lanes_mixed_blocked`) — the whole
+`serve/` stack on O(NB+K) touched rows per step instead of the flat
+engine's O(CAP) (ROADMAP open item #5; the continuous-batching analogue
+of paged/incremental KV state in LLM inference serving: fixed-shape
+device steps whose per-step cost tracks the *edit*, not the *document*).
+
+Three things make this a backend rather than a replay driver:
+
+1. **Persistent per-tick state.** ``make_replayer_lanes_mixed_blocked``
+   was built for chunked replays; here its 11-tuple ``state()`` (block
+   planes, logical tables, by-order origin tables, the order->block
+   hint + split forward pointers) is carried ACROSS ticks as the lanes'
+   device state, with each tick's stacked ``[S, B]`` stream applied as
+   one warm-started chunk.  Tick step counts are already padded to the
+   batcher's static buckets, so the shape-keyed kernel cache compiles
+   one program per bucket and steady state never recompiles
+   (``shapes_seen`` stays bounded exactly as the flat backend asserts).
+   Author ranks are a read-only kernel input, so the backend accumulates
+   the full by-order rank table host-side across ticks (chunk-chaining
+   contract of ``make_replayer_lanes_mixed``'s ``rkl``) — which is also
+   what agent-onboarding rank remaps rewrite.
+
+2. **Per-lane residency writes.** ``upload_lane`` synthesizes one
+   lane's columns from a restored oracle — runs via
+   ``lane_blocks.oracle_runs``, half-full K-row blocks via
+   ``lane_blocks.pack_lane_blocks``, by-order origin/rank tables and the
+   order->block hint directly from the oracle's columns — and writes
+   them into the carried state with every other lane untouched
+   (``.at[:, b].set``); ``clear_lane`` writes the empty column.
+
+3. **Run-row capacity semantics.** The blocked planes hold RUN rows,
+   not chars, and leaf splits need free blocks, so ``fits`` cannot be
+   the flat backend's char-count probe.  The backend tracks per-lane
+   run-row occupancy host-side (upper-bounded by +2 rows per ACTIVE op
+   branch between barriers — a compiled local replace step fires both
+   the delete and the insert branch — trued up from the device at each
+   barrier) and bounds it by ``row_budget``: every split-born or seeded block holds at least
+   ``(K-1)//2`` rows, so running out of blocks requires at least
+   ``(NB-1) * (K-1)//2`` occupied rows — staying strictly below that
+   makes the kernel's capacity flag unreachable.  Overflow therefore
+   degrades host-side (``tick_fits``/``fits_doc`` refuse, residency
+   frees the lane) before the device could ever flag, same contract as
+   the flat backend, different unit.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+from ..ops import batch as B
+from ..ops import rle_lanes_mixed as RLM
+from ..ops.lane_blocks import oracle_runs, pack_lane_blocks
+
+
+class LanesMixedLaneBackend:
+    """The blocked per-lane MIXED engine as a serve lane backend: one
+    persistent blocked state 11-tuple per shard, applied with one
+    warm-started kernel call per ``[S, B]`` tick.
+
+    Implements the full surface ``serve.batcher.FlatLaneBackend``
+    documents (``apply`` / ``clear_lane`` / ``upload_lane`` /
+    ``remap_lane_ranks`` / ``lane_signed`` / ``fits`` / ``fits_doc`` /
+    ``tick_fits`` / ``barrier``).  ``capacity`` counts RUN rows per lane
+    (rounded up to a ``block_k`` multiple); ``order_capacity`` rows of
+    by-order table per lane (rounded up to a multiple of 8)."""
+
+    engine = "rle-lanes-mixed"
+
+    def __init__(self, lanes: int, capacity: int, order_capacity: int,
+                 lmax: int, block_k: int = 64,
+                 interpret: Optional[bool] = None):
+        from ..config import lane_block_geometry
+
+        self.lanes = lanes
+        self.lmax = lmax
+        self.block_k = max(8, min(block_k, capacity))
+        self.capacity, self.NB, self.NBT = lane_block_geometry(
+            capacity, self.block_k)
+        self.order_capacity = ((order_capacity + 7) // 8) * 8
+        # Pallas needs the interpreter off-TPU; on silicon run compiled.
+        self.interpret = (jax.default_backend() != "tpu"
+                          if interpret is None else interpret)
+        self._state = RLM._empty_mixed_blocked_state(
+            self.capacity, self.NBT, self.order_capacity, lanes)
+        # One cached empty column for clear_lane (an eviction-path
+        # hot spot: hundreds of clears per loadgen run).
+        self._empty_cols = tuple(
+            e[:, 0] for e in RLM._empty_mixed_blocked_state(
+                self.capacity, self.NBT, self.order_capacity, 1))
+        # Host-accumulated full by-order rank table (the kernel's rkl is
+        # a read-only input; see make_replayer_lanes_mixed's rkl doc).
+        self._rkl = np.zeros((self.order_capacity, lanes), np.int32)
+        # Host upper bound on per-lane run rows (exact at barriers).
+        self._lane_rows = np.zeros(lanes, np.int64)
+        self._pending = None       # last tick's un-barriered result
+        self.shapes_seen: set = set()   # compiled (S,) tick shapes
+
+    # -- capacity probes ----------------------------------------------------
+
+    @property
+    def row_budget(self) -> int:
+        """Max run rows a lane may hold such that the kernel can never
+        run out of blocks: out-of-blocks requires every block allocated,
+        and all but one block (seeded or split-born) holds at least
+        ``(K-1)//2`` rows, so staying below ``(NB-1)*(K-1)//2`` rows
+        (minus 2 rows of slack; the probes bound each stream's FULL
+        growth before it applies) keeps the device capacity flag
+        unreachable."""
+        return max(0, (self.NB - 1) * ((self.block_k - 1) // 2) - 2)
+
+    def _orders_fit(self, next_order: int) -> bool:
+        return next_order <= self.order_capacity - self.lmax
+
+    def fits(self, n: int, next_order: int) -> bool:
+        """Shape-only probe: ``n`` body rows taken as the (worst-case)
+        run count.  Callers holding the oracle get the exact answer from
+        ``fits_doc``."""
+        return n + 2 <= self.row_budget and self._orders_fit(next_order)
+
+    def fits_doc(self, oracle) -> bool:
+        """Exact upload-path probe: the oracle's true run count (what
+        ``upload_lane`` will seed) against the row budget."""
+        runs = len(oracle_runs(oracle)[0])
+        return (runs + 2 <= self.row_budget
+                and self._orders_fit(oracle.get_next_order()))
+
+    @staticmethod
+    def _stream_growth(del_len, ins_len) -> np.ndarray:
+        """Sound run-row growth bound of a stream, per trailing lane
+        axis: each ACTIVE branch of a step splices at most +2 rows (a
+        3-way delete split, or an insert split), and a compiled local
+        REPLACE step fires both branches — so the bound is 2 rows per
+        active branch, NOT 2 per step (a 2/step bound is reachable by
+        ``submit_local(..., del_len=k, ins_content=...)``, and crossing
+        it would make the kernel's out-of-blocks flag reachable)."""
+        d = np.asarray(del_len) > 0
+        i = np.asarray(ins_len) > 0
+        return 2 * (d.astype(np.int64) + i.astype(np.int64)).sum(axis=0)
+
+    def tick_fits(self, b: int, oracle, stream) -> bool:
+        """Pre-apply probe for lane ``b``'s compiled tick stream: the
+        lane's tracked run rows plus the stream's sound growth bound
+        must stay inside the budget."""
+        growth = int(self._stream_growth(stream.del_len, stream.ins_len))
+        return (int(self._lane_rows[b]) + growth <= self.row_budget
+                and self._orders_fit(oracle.get_next_order()))
+
+    # -- residency writes ---------------------------------------------------
+
+    def clear_lane(self, b: int) -> None:
+        self._state = tuple(
+            s.at[:, b].set(e)
+            for s, e in zip(self._state, self._empty_cols))
+        self._rkl[:, b] = 0
+        self._lane_rows[b] = 0
+
+    def upload_lane(self, b: int, oracle, rank_of_agent) -> None:
+        """Seed lane ``b`` wholesale from a (restored) oracle: packed
+        half-full blocks, by-order origin tables, author ranks, and a
+        fully-warm order->block hint — other lanes' carried state is
+        untouched."""
+        starts, lens = oracle_runs(oracle)
+        packed, run_block = pack_lane_blocks(
+            starts, lens, K=self.block_k, NB=self.NB, NBT=self.NBT,
+            capacity=self.capacity)
+        cols = list(packed)
+        ocap = self.order_capacity
+        n = oracle.n
+        order = oracle.order[:n].astype(np.int64)
+        assert oracle.get_next_order() <= ocap, (
+            f"doc ({oracle.get_next_order()} orders) exceeds order "
+            f"capacity {ocap}")
+
+        def table_from(items):
+            # u32 view -> i32 turns ROOT (0xFFFFFFFF) into the kernels'
+            # -1 root sentinel; absent orders stay TAB_UNKNOWN.
+            out = np.full(ocap, RLM.TAB_UNKNOWN, np.int32)
+            out[order] = items[:n].astype(np.uint32).view(np.int32)
+            return out
+
+        oll = table_from(oracle.origin_left)
+        orl = table_from(oracle.origin_right)
+        # order -> physical block hint: run r's whole span points at the
+        # block pack_lane_blocks placed it in (the packer owns the
+        # occupancy rule; this just expands its assignment per order).
+        ordblk = np.full(ocap, -1, np.int32)
+        if len(starts):
+            ordblk[np.repeat(np.abs(starts) - 1, lens)
+                   + _within(lens)] = np.repeat(run_block, lens)
+        fwd = np.full(self.NBT, -1, np.int32)
+        cols.extend([oll, orl, ordblk, fwd])
+        self._state = tuple(
+            s.at[:, b].set(np.asarray(c))
+            for s, c in zip(self._state, cols))
+
+        # Per-item author rank by order (`span_arrays.upload_oracle`'s
+        # searchsorted over the client_with_order runs).
+        rkl = np.zeros(ocap, np.int32)
+        if n:
+            run_starts = np.asarray(
+                [e.order for e in oracle.client_with_order], np.int64)
+            run_agents = np.asarray(
+                [e.agent for e in oracle.client_with_order], np.int64)
+            run_idx = np.searchsorted(run_starts, order,
+                                      side="right") - 1
+            rkl[order] = np.asarray(rank_of_agent)[
+                run_agents[run_idx]].astype(np.int32)
+        self._rkl[:, b] = rkl
+        self._lane_rows[b] = len(starts)
+
+    def remap_lane_ranks(self, b: int, mapping: np.ndarray) -> None:
+        """Agent-onboarding epoch re-base: rewrite lane ``b``'s column
+        of the accumulated rank table through the old->new rank map
+        (entries at or past ``len(mapping)`` — never written by the old
+        epoch — pass through, as `span_arrays.remap_rank_log`)."""
+        m = np.asarray(mapping, dtype=np.int64)
+        col = self._rkl[:, b].astype(np.int64)
+        safe = np.minimum(col, len(m) - 1)
+        self._rkl[:, b] = np.where(col < len(m), m[safe],
+                                   col).astype(np.int32)
+
+    # -- the tick -----------------------------------------------------------
+
+    def apply(self, stacked: B.OpTensors) -> None:
+        """One [S, B] tick as a warm-started blocked-kernel chunk.  The
+        batcher pads S to a static bucket, so ``chunk=S`` makes the
+        shape-keyed kernel cache hold exactly one compiled program per
+        bucket."""
+        if self._pending is not None:
+            self.barrier()
+        S = int(stacked.num_steps)
+        self._merge_rank_prefill(stacked)
+        run = RLM.make_replayer_lanes_mixed_blocked(
+            stacked, self.capacity, block_k=self.block_k,
+            order_capacity=self.order_capacity, chunk=S,
+            init=self._state, rkl=self._rkl, interpret=self.interpret)
+        res = run()
+        self.shapes_seen.add(S)
+        self._state = res.state()
+        self._pending = res
+        self._lane_rows = self._lane_rows + self._stream_growth(
+            stacked.del_len, stacked.ins_len)
+
+    def _merge_rank_prefill(self, stacked: B.OpTensors) -> None:
+        """Fold this tick's compile-known author ranks into the
+        host-accumulated full table (earlier ticks' ranks must stay
+        visible to later YATA tiebreaks — the chunk-chaining rkl
+        contract).  One host materialization of the batch, then
+        per-lane column slices (not one transfer per lane)."""
+        host = jax.tree.map(np.asarray, stacked)
+        for b in range(self.lanes):
+            per = jax.tree.map(lambda a: a[:, b], host)
+            sc = B._prefill_scatter(per)
+            if sc is not None:
+                self._rkl[sc["rank"][0], b] = sc["rank"][1].astype(
+                    np.int32)
+
+    def barrier(self) -> None:
+        """Materialize the tick's outputs; surface any kernel flag
+        loudly (the host-side probes make every flag unreachable, so a
+        raise here is a backend bug, not load) and true up the per-lane
+        run-row bound from the device's exact counts."""
+        res, self._pending = self._pending, None
+        if res is None:
+            return
+        res.check()
+        self._lane_rows = np.asarray(res.rows)[0].astype(np.int64).copy()
+
+    # -- readback -----------------------------------------------------------
+
+    def lane_signed(self, b: int) -> np.ndarray:
+        """±(order+1) body column of lane ``b`` in document order (walk
+        the logical block table; the bit-identity comparison target)."""
+        ordp = np.asarray(self._state[0])[:, b]
+        lenp = np.asarray(self._state[1])[:, b]
+        nlog = int(np.asarray(self._state[2])[0, b])
+        blkord = np.asarray(self._state[3])[:, b]
+        rws = np.asarray(self._state[4])[:, b]
+        K = self.block_k
+        o_parts: List[np.ndarray] = []
+        l_parts: List[np.ndarray] = []
+        for sl in range(nlog):
+            blk, r = int(blkord[sl]), int(rws[sl])
+            o_parts.append(ordp[blk * K: blk * K + r])
+            l_parts.append(lenp[blk * K: blk * K + r])
+        o = (np.concatenate(o_parts) if o_parts
+             else np.zeros(0, np.int32)).astype(np.int64)
+        ln = (np.concatenate(l_parts) if l_parts
+              else np.zeros(0, np.int32)).astype(np.int64)
+        if len(o) == 0:
+            return np.zeros(0, np.int32)
+        base = np.repeat(np.abs(o), ln)
+        return (np.repeat(np.sign(o), ln)
+                * (base + _within(ln))).astype(np.int32)
+
+
+def _within(lens: np.ndarray) -> np.ndarray:
+    """0..len-1 counters concatenated across runs."""
+    total = int(lens.sum())
+    return np.arange(total) - np.repeat(np.cumsum(lens) - lens, lens)

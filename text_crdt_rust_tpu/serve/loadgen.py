@@ -228,10 +228,14 @@ class ServeLoadGen:
             world = self.worlds[d]
             if self.rng.random() < self.local_prob:
                 # A server-side edit; position bounded by the doc's
-                # current live length when resident, 0 (always valid)
-                # while evicted — the touch drives the restore path.
-                doc = self.server.doc_state(world.doc_id)
-                live = len(doc.oracle) if doc.resident else 0
+                # TWIN length — a server-state-independent source, so
+                # one seed generates byte-identical traffic on every
+                # lane backend (the cross-backend bit-identity twin
+                # runs of ISSUE 4 depend on it; a position the server
+                # hasn't caught up to yet is validity-checked at apply
+                # time and dropped, deterministically). The edit still
+                # *touches* evicted docs, driving the restore path.
+                live = len(world.twin)
                 pos = self.rng.randint(0, live)
                 ins = "".join(self.rng.choice("xyzw")
                               for _ in range(self.rng.randint(1, 3)))
@@ -253,9 +257,12 @@ class ServeLoadGen:
         if (tick_index + 1) % self.resync_every == 0:
             self._gossip_digests(faulty=True)
             self._resync(faulty=True)
-        stats = self.server.tick()
-        self._observe_server_edits()
-        return stats
+        # Server-authored history reaches the twins in the final
+        # observation pass, NOT per tick: per-tick observation is gated
+        # on residency, which differs across lane backends — it would
+        # leak backend state into the twin lengths that seed the next
+        # tick's traffic (see run_tick's position source).
+        return self.server.tick()
 
     # -- the full run --------------------------------------------------------
 
@@ -282,7 +289,6 @@ class ServeLoadGen:
         for drain_rounds in range(1, 64):
             wanting = self._resync(faulty=False)
             self.server.tick()
-            self._observe_server_edits()
             busy = any(d.events for d in self.server.router.docs.values())
             if not wanting and not busy:
                 break
@@ -303,6 +309,8 @@ class ServeLoadGen:
             "wall_s": round(wall, 3),
             "rejected_submissions": self.rejections,
             "latency_us": self.server.latency_summary(),
+            "tick_ms": self.server.tick_summary(),
+            "engine": self.cfg.engine,
             "server": stats,
         }
         return report
@@ -351,13 +359,23 @@ def main(argv=None) -> None:
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--shards", type=int, default=2)
     ap.add_argument("--lanes", type=int, default=16)
+    ap.add_argument("--engine", default="flat",
+                    help="registry engine backing the lane batches "
+                         "(any engine with a serve backend: flat, "
+                         "rle-lanes-mixed)")
+    ap.add_argument("--device", action="store_true",
+                    help="run on the default jax backend (TPU when the "
+                         "tunnel is up) instead of forcing CPU — the "
+                         "perf/when_up_r7.sh on-silicon serve smoke")
     ap.add_argument("--verbose", action="store_true")
     a = ap.parse_args(argv)
 
     import jax
 
-    jax.config.update("jax_platforms", "cpu")
-    cfg = ServeConfig(num_shards=a.shards, lanes_per_shard=a.lanes)
+    if not a.device:
+        jax.config.update("jax_platforms", "cpu")
+    cfg = ServeConfig(engine=a.engine, num_shards=a.shards,
+                      lanes_per_shard=a.lanes)
     gen = ServeLoadGen(docs=a.docs, agents_per_doc=a.agents, ticks=a.ticks,
                        events_per_tick=a.events_per_tick, zipf_alpha=a.zipf,
                        fault_rate=a.fault_rate, local_prob=a.local_prob,

@@ -102,7 +102,9 @@ class LaneResidency:
         hotter) — a deferral, not a failure."""
         assert doc.resident and not doc.in_lane
         backend = self.backends[doc.shard]
-        if not backend.fits(doc.oracle.n, doc.oracle.get_next_order()):
+        # fits_doc is the backend's EXACT occupancy probe (chars for the
+        # flat engine, RLE run rows for the blocked lanes engine).
+        if not backend.fits_doc(doc.oracle):
             self.degrade(doc, f"doc ({doc.oracle.n} rows, "
                               f"{doc.oracle.get_next_order()} orders) "
                               f"exceeds lane capacity "
@@ -153,6 +155,10 @@ class LaneResidency:
         out). Returns the checkpoint path."""
         assert doc.resident, "evicting an already-evicted doc"
         path = self._ckpt_path(doc.doc_id)
+        # Snapshot the oracle's per-agent watermarks first: REQUEST
+        # emission must keep seeing the persisted history's extent
+        # (router.poll_request_frame reads known_marks).
+        doc.absorb_oracle_marks()
         checkpoint.save_doc(doc.oracle, path)
         doc.ckpt_path = path
         doc.oracle = None

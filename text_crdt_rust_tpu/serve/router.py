@@ -93,10 +93,28 @@ class DocState:
         # gossip that reveals gaps the causal buffer cannot see (every
         # frame from an agent dropped), exactly as in `net/session.py`.
         self.peer_marks: Dict[str, int] = {}
+        # High-water of the ORACLE's own per-agent watermarks, kept
+        # fresh while resident and surviving eviction (the checkpoint
+        # holds that history): REQUEST emission reads these so an
+        # evicted doc never re-requests ranges it already persisted —
+        # and so the owed-wants computation is independent of residency
+        # timing (the loadgen's cross-backend determinism relies on it).
+        self.known_marks: Dict[str, int] = {}
         self.degraded = False          # lane overflow: host-only forever
         self.degrade_reason = ""
         self.last_touch_tick = 0
         self.divergence_detected = False
+
+    def absorb_oracle_marks(self) -> None:
+        """Fold the resident oracle's per-agent watermarks into
+        ``known_marks`` (max-merge).  Called wherever the oracle's
+        history extent must survive the oracle's absence — REQUEST
+        emission while resident, and the eviction snapshot."""
+        if self.oracle is None:
+            return
+        for agent, wm in agent_watermarks(self.oracle).items():
+            if wm > self.known_marks.get(agent, 0):
+                self.known_marks[agent] = wm
 
     @property
     def resident(self) -> bool:
@@ -301,9 +319,9 @@ class ShardRouter:
         for rid in doc.buffer.missing():
             wants[rid.agent] = min(wants.get(rid.agent, rid.seq), rid.seq)
         marks = dict(doc.buffer.watermarks())
-        if doc.resident:
-            for agent, wm in agent_watermarks(doc.oracle).items():
-                marks[agent] = max(marks.get(agent, 0), wm)
+        doc.absorb_oracle_marks()
+        for agent, wm in doc.known_marks.items():
+            marks[agent] = max(marks.get(agent, 0), wm)
         for agent, peer_wm in doc.peer_marks.items():
             mine = marks.get(agent, 0)
             if peer_wm > mine:

@@ -55,7 +55,8 @@ class DocServer:
             make_lane_backend(cfg.engine, lanes=cfg.lanes_per_shard,
                               capacity=cfg.lane_capacity,
                               order_capacity=cfg.order_capacity,
-                              lmax=cfg.lmax)
+                              lmax=cfg.lmax, block_k=cfg.lanes_block_k,
+                              interpret=cfg.interpret)
             for _ in range(cfg.num_shards)
         ]
         self.residency = LaneResidency(backends, self.router,
@@ -137,9 +138,21 @@ class DocServer:
         out["samples"] = len(us)
         return out
 
+    def tick_summary(self) -> Dict[str, float]:
+        """Serve tick wall-latency percentiles in milliseconds (one
+        sample per ``tick()`` — the fixed-shape device pass plus the
+        host drain around it)."""
+        ms = [s * 1e3 for s in self.batcher.tick_wall_samples]
+        out = {k: round(v, 3)
+               for k, v in percentiles(ms, (50, 99)).items()}
+        out["samples"] = len(ms)
+        return out
+
     def stats(self) -> Dict[str, float]:
         out = dict(self.counters.summary())
         out.update(self.residency.resident_counts())
         out.update({f"latency_us_{k}": v
                     for k, v in self.latency_summary().items()})
+        out.update({f"tick_ms_{k}": v
+                    for k, v in self.tick_summary().items()})
         return out
