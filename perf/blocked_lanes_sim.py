@@ -543,7 +543,8 @@ def _replay_stream(sim: BlockedLaneSim, unb: UnblockedCost, c: Counter,
             sim.begin_step(); sim.remote_delete(int(dtg[s]), dl); sim.end_step()
 
 
-def serve_workload(smoke: bool = False):
+def serve_workload(smoke: bool = False, block_k: int = 0,
+                   engines=("rle-lanes-mixed", "flat")):
     """The ISSUE-4 acceptance + perf probe: run the seeded serve
     loadgen on BOTH lane backends (bit-identity proof), replaying the
     lanes run's tick trace through the kernel-exact blocked cost model
@@ -555,13 +556,18 @@ def serve_workload(smoke: bool = False):
     touched-rows model (CAP = the serve lane capacity).  Both models
     assume shallow YATA scans (serve edits are small and conflicts
     rare); splice/locate/split costs are kernel-exact.
+
+    ``block_k`` overrides ``ServeConfig.lanes_block_k`` (the --sweep-k
+    driver); ``engines`` narrows the run (the sweep skips the flat twin
+    — it is K-independent — and leans on the loadgen's built-in
+    always-resident oracle twin for convergence).
     """
     from text_crdt_rust_tpu.config import ServeConfig, lane_block_geometry
     from text_crdt_rust_tpu.serve.loadgen import ServeLoadGen
 
     docs, ticks, events = (24, 10, 16) if smoke else (200, 60, 48)
     base = ServeConfig()
-    K = base.lanes_block_k
+    K = block_k or base.lanes_block_k
     cap_runs, NB, NBT = lane_block_geometry(base.lane_capacity, K)
     OCAP = base.order_capacity
     c = Counter()
@@ -571,9 +577,9 @@ def serve_workload(smoke: bool = False):
     strings = {}
     shapes = None
 
-    for engine in ("rle-lanes-mixed", "flat"):
+    for engine in engines:
         scfg = ServeConfig(engine=engine, num_shards=2,
-                           lanes_per_shard=16)
+                           lanes_per_shard=16, lanes_block_k=K)
         gen = ServeLoadGen(docs=docs, agents_per_doc=3, ticks=ticks,
                            events_per_tick=events, zipf_alpha=1.1,
                            fault_rate=0.10, local_prob=0.25, seed=7,
@@ -615,7 +621,8 @@ def serve_workload(smoke: bool = False):
                 *(b.shapes_seen
                   for b in gen.server.residency.backends)))
 
-    bit_identical = strings["rle-lanes-mixed"] == strings["flat"]
+    bit_identical = (strings["rle-lanes-mixed"] == strings["flat"]
+                     if "flat" in strings else None)
     tr = c.unb_touched / max(c.blk_touched, 1)
     pr = c.unb_traffic / max(c.blk_traffic, 1)
     out = {
@@ -669,6 +676,59 @@ def serve_workload(smoke: bool = False):
     return out
 
 
+def sweep_k_workload(smoke: bool = False, ks=(8, 16, 32, 64)):
+    """Serve-tuned K sweep (ROADMAP item 5 remainder): re-run the
+    seeded serve loadgen on the lanes backend at several
+    ``lanes_block_k`` values and replay each run's tick trace through
+    the kernel-exact cost model.  The flat twin is skipped (its cost is
+    K-independent); convergence per run leans on the loadgen's built-in
+    always-resident oracle twin.  The chosen default minimizes blocked
+    touched rows/step (NBT + K is the per-step floor, so the sweep is
+    a real tradeoff: small K inflates the NBT logical table and the
+    NB-way select chains, large K inflates every in-block pass), with
+    pass traffic as the tiebreak."""
+    rows = []
+    for k in ks:
+        t0 = time.perf_counter()
+        out = serve_workload(smoke=smoke, block_k=k,
+                             engines=("rle-lanes-mixed",))
+        lanes = out["per_engine"]["rle-lanes-mixed"]
+        assert lanes["converged"], f"K={k} loadgen diverged"
+        rows.append({
+            "lanes_block_k": k,
+            "NB": out["workload"]["NB"],
+            "NBT": out["workload"]["NBT"],
+            "trace_steps": out["trace_steps"],
+            "splits": out["splits"],
+            "hint_misses": out["hint_misses"],
+            "touched_rows_per_step":
+                out["touched_rows_per_step"]["lanes_blocked"],
+            "pass_traffic_per_step":
+                out["pass_traffic_per_step"]["lanes_blocked"],
+            "vs_flat_touched_ratio":
+                out["touched_rows_per_step"]["ratio"],
+            "tick_ms": lanes["tick_ms"],
+            "wall_s": round(time.perf_counter() - t0, 1),
+        })
+        print(f"K={k}: touched/step "
+              f"{rows[-1]['touched_rows_per_step']}, traffic/step "
+              f"{rows[-1]['pass_traffic_per_step']}, splits "
+              f"{rows[-1]['splits']} ({rows[-1]['wall_s']}s)",
+              file=sys.stderr)
+    best = min(rows, key=lambda r: (r["touched_rows_per_step"],
+                                    r["pass_traffic_per_step"]))
+    return {
+        "workload": "serve loadgen tick trace (see serve_workload)",
+        "smoke": smoke,
+        "sweep": rows,
+        "chosen_lanes_block_k": best["lanes_block_k"],
+        "note": "CPU sim (kernel-exact step-cost replay; tick_ms is "
+                "interpreter wall, not silicon). ServeConfig."
+                "lanes_block_k carries the chosen default; re-validate "
+                "on chip via perf/when_up_r8.sh.",
+    }
+
+
 def report(name, c: Counter, caps):
     tr = c.unb_touched / max(c.blk_touched, 1)
     pr = c.unb_traffic / max(c.blk_traffic, 1)
@@ -696,10 +756,30 @@ def main():
                     help="replay the serve loadgen tick trace instead "
                          "of configs 5/5r (ISSUE 4); writes "
                          "perf/serve_lanes_r7.json")
+    ap.add_argument("--sweep-k", action="store_true",
+                    help="with --serve: sweep the lanes backend's "
+                         "lanes_block_k over --ks and record the "
+                         "chosen default (writes perf/serve_k_sweep"
+                         ".json unless --smoke)")
+    ap.add_argument("--ks", default="8,16,32,64",
+                    help="comma-separated K values for --sweep-k")
     ap.add_argument("--smoke", action="store_true",
                     help="with --serve: tiny workload (CI)")
     ap.add_argument("--out", default="perf/serve_lanes_r7.json")
     args = ap.parse_args()
+    if args.serve and args.sweep_k:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        ks = tuple(int(x) for x in args.ks.split(","))
+        out = sweep_k_workload(smoke=args.smoke, ks=ks)
+        if not args.smoke:
+            path = "perf/serve_k_sweep.json"
+            with open(path, "w") as f:
+                json.dump(out, f, indent=1)
+            print(f"wrote {path}", file=sys.stderr)
+        print(json.dumps(out))
+        return 0
     if args.serve:
         import jax
 

@@ -1,0 +1,46 @@
+#!/bin/bash
+# Round-8 recovery watcher (ISSUE 5 / ROADMAP #3): kevin's fused
+# split-batch prepare has only run on CPU interpret — the 5M silicon
+# re-record (engine rle-hbm-fused, W=64, ~78k device steps instead of
+# 5M) is the headline this round arms.  Also still pending from r6/r7:
+# configs 4/5/5r on-chip (the vectorized YATA scan + blocked lanes
+# engines are CPU-proven only) and the serve/serve-lanes/sp rows.
+# Each config re-records through the new `--merge-rows` path (single
+# config -> BENCH_ALL.json row replacement; no hand-editing, no
+# whole-suite resume).
+# Safe to re-run; appends to perf/when_up_r8.log.
+set -u
+cd /root/repo
+while true; do
+  if timeout 240 python -c "
+import jax, numpy as np, jax.numpy as jnp
+x = jnp.ones((128,128), jnp.bfloat16)
+assert float(np.asarray(x @ x)[0,0]) == 128.0
+" >/dev/null 2>&1; then
+    echo "$(date -u +%H:%M:%S) tunnel is back (r8 watcher)" >> perf/when_up_r8.log
+    break
+  fi
+  echo "$(date -u +%H:%M:%S) still down (r8)" >> perf/when_up_r8.log
+  sleep 120
+done
+timeout 2400 python perf/compile_pin.py >> perf/compile_pin_r8.log 2>&1 \
+  || echo "PIN FAILED/TIMED OUT rc=$? - investigate before trusting bench" \
+       >> perf/compile_pin_r8.log
+# Fused-kernel device smoke first: a tiny fused kevin (2048 prepends,
+# W=8) proves the W-row splice + rows_per_step SMEM column compile on
+# real Mosaic before committing to the 40-min full run.
+timeout 1800 python bench.py --config kevin --smoke --no-probe \
+  >> perf/when_up_r8.log 2>&1 \
+  || { echo "fused kevin device smoke FAILED rc=$? - NOT re-recording" \
+         >> perf/when_up_r8.log; exit 1; }
+# Headline: kevin at full 5M, fused W=64 (rle-hbm-fused row).
+timeout 7200 python bench.py --config kevin --merge-rows --no-probe \
+  >> perf/bench_kevin_r8.log 2>&1 \
+  || echo "kevin re-record FAILED rc=$?" >> perf/when_up_r8.log
+# Still-pending r6/r7 rows, most verdict-critical first.
+for cfg in 4 5r 5 northstar serve serve-lanes sp; do
+  timeout 7200 python bench.py --config "$cfg" --merge-rows --no-probe \
+    >> "perf/bench_cfg${cfg}_r8.log" 2>&1 \
+    || echo "config $cfg re-record FAILED rc=$?" >> perf/when_up_r8.log
+done
+echo "$(date -u +%H:%M:%S) r8 re-record done" >> perf/when_up_r8.log

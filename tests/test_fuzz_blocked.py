@@ -158,3 +158,11 @@ class TestSpRemoteRideAlong:
         1,000 further seeds in the slow tier."""
         for seed in range(40_050, 41_050):
             self._round(seed)
+
+    @pytest.mark.slow
+    def test_500_more_seeds_round8(self):
+        """Round-8 growth (ISSUE 5 satellite): a further fresh 500-seed
+        range for the sp-remote ride-along, keeping this surface at
+        parity with the blocked-lanes sweeps as rounds accumulate."""
+        for seed in range(41_050, 41_550):
+            self._round(seed)

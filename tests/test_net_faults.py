@@ -184,6 +184,13 @@ class TestTwoPeerFuzz:
     def test_full_500_seeds(self):
         _fuzz_seed_range(range(500))
 
+    @pytest.mark.slow
+    def test_deep_500_more_seeds(self):
+        """Deep-fuzz volume (ROADMAP #6): grow the net-mesh surface
+        toward parity with the 1,000+-seed blocked-lanes sweeps —
+        500 further two-peer seeds on a fresh range."""
+        _fuzz_seed_range(range(500, 1000))
+
     def test_faultless_channel_converges_fast(self):
         sa, sb, _, _ = pump_two_peer(
             9999, faults=FaultSpec(), max_rounds=EDIT_ROUNDS + 4)
@@ -241,6 +248,17 @@ class TestNPeerFuzz:
     @pytest.mark.slow
     def test_three_peer_mesh_50_seeds(self):
         for seed in range(10, 60):
+            docs = self._pump_mesh(seed)
+            texts = {d.to_string() for d in docs}
+            assert len(texts) == 1
+
+    @pytest.mark.slow
+    def test_three_peer_mesh_190_more_seeds(self):
+        """Deep-fuzz volume (ROADMAP #6): the mesh surface is the
+        costliest per seed (6 directed sessions), so it grows in
+        larger strides per round — 190 further seeds here (60..250
+        cumulative) toward the 1,000-seed blocked-lanes parity."""
+        for seed in range(60, 250):
             docs = self._pump_mesh(seed)
             texts = {d.to_string() for d in docs}
             assert len(texts) == 1

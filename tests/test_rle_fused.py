@@ -1,0 +1,251 @@
+"""Fused multi-row insert steps (split-batch prepare, ISSUE 5).
+
+The kevin worst case (`benches/yjs.rs:51-62`) is a backwards-contiguous
+insert burst: every char lands at the same position, BEFORE the previous
+one, so runs cannot merge and the unfused engines pay one device step
+per character.  ``batch.compile_local_patches(fuse_w=W)`` compiles such
+bursts into ONE ``rows_per_step=W`` step whose W pre-built rows the
+``ops.rle`` / ``ops.rle_hbm`` splice lands in a single shift.
+
+The correctness burden (same as the PR-2/4 blocked engines): fused and
+unfused streams must be bit-identical — the final ``expand_runs`` order
+sequence AND the merged by-order logs (``rle_to_flat``: origins, ranks,
+chars) — against each other and the flat-engine oracle, because the
+fused rows bake in origin chains the unfused path derives step-by-step.
+
+Shapes are FIXED across seeds (pad to SMAX, one geometry) so the whole
+file costs a handful of pallas interpret compiles, keeping tier-1
+inside its budget.
+"""
+import random
+
+import numpy as np
+import pytest
+
+from text_crdt_rust_tpu.ops import batch as B
+from text_crdt_rust_tpu.ops import flat as F
+from text_crdt_rust_tpu.ops import rle as R
+from text_crdt_rust_tpu.ops import rle_hbm as RH
+from text_crdt_rust_tpu.ops import span_arrays as SA
+from text_crdt_rust_tpu.utils.testdata import TestPatch
+
+SMAX = 128     # fixed padded step count (all streams share one trace)
+CAPF = 512     # run-row capacity
+KF = 16        # block_k (tiny: fused steps hit leaf splits constantly)
+FW = 6         # fuse width under test (<= KF//2 - 1 = 7)
+GEOM = dict(capacity=CAPF, batch=8, block_k=KF, chunk=64, interpret=True)
+
+DOC_FIELDS = ("signed", "ol_log", "or_log", "rank_log", "chars_log",
+              "n", "next_order")
+
+
+def _compile_pair(patches, fuse_w=FW, lmax=16):
+    """(unfused, fused) op tensors of one patch stream, padded to SMAX."""
+    ops_u, no_u = B.compile_local_patches(patches, lmax=lmax, dmax=None)
+    ops_f, no_f = B.compile_local_patches(patches, lmax=lmax, dmax=None,
+                                          fuse_w=fuse_w)
+    assert no_u == no_f
+    assert ops_u.num_steps <= SMAX and ops_f.num_steps <= SMAX, \
+        "bump SMAX"
+    return B.pad_ops(ops_u, SMAX), B.pad_ops(ops_f, SMAX)
+
+
+def _assert_equivalent(ops_u, ops_f, res_u, res_f, content=None):
+    assert np.array_equal(R.expand_runs(res_u), R.expand_runs(res_f)), \
+        "fused expand_runs order sequence diverged from unfused"
+    du = R.rle_to_flat(ops_u, res_u, capacity=1024)
+    df = R.rle_to_flat(ops_f, res_f, capacity=1024)
+    for f in DOC_FIELDS:
+        assert np.array_equal(np.asarray(getattr(du, f)),
+                              np.asarray(getattr(df, f))), f
+    if content is not None:
+        assert SA.to_string(df) == content
+    return du, df
+
+
+def burst_patches(rng, n):
+    """Mixed stream: same-position insert bursts (prepend-heavy, the
+    fusable shape) + forward typing + deletes.  Always OPENS with a
+    full-width burst so every compiled stream carries rows_per_step ==
+    FW (one static WMAX -> one kernel compile for the whole file)."""
+    patches, content = [], ""
+    for _ in range(FW):
+        patches.append(TestPatch(0, 0, "s"))
+        content = "s" + content
+    while len(patches) < n:
+        roll = rng.random()
+        if roll < 0.45:
+            pos = rng.randrange(len(content) + 1)
+            L = rng.randint(1, 2)
+            for _ in range(rng.randint(2, FW + 3)):
+                s = "".join(rng.choice("abcdefgh") for _ in range(L))
+                patches.append(TestPatch(pos, 0, s))
+                content = content[:pos] + s + content[pos:]
+        elif roll < 0.75:
+            pos = rng.randrange(len(content) + 1)
+            s = "".join(rng.choice("xyz")
+                        for _ in range(rng.randint(1, 5)))
+            patches.append(TestPatch(pos, 0, s))
+            content = content[:pos] + s + content[pos:]
+        elif content:
+            pos = rng.randrange(len(content))
+            d = min(rng.randint(1, 6), len(content) - pos)
+            patches.append(TestPatch(pos, d, ""))
+            content = content[:pos] + content[pos + d:]
+    return patches, content
+
+
+class TestFusedCompile:
+    def test_burst_detection_and_chunking(self):
+        patches = [TestPatch(3, 0, "ab")] * 7 + [TestPatch(0, 0, "q")]
+        ops, _ = B.compile_local_patches(patches, lmax=8, fuse_w=4)
+        # 7-burst of L=2 chunks at min(fuse_w, lmax//L)=4: [4, 3] + tail.
+        assert ops.rows_per_step.tolist() == [4, 3, 1]
+        assert ops.ins_len.tolist() == [8, 6, 1]
+        assert B.fused_width(ops) == 4
+
+    def test_w1_degenerate_is_todays_stream(self):
+        # A burst-free stream compiles IDENTICALLY with fusion enabled.
+        patches = [TestPatch(0, 0, "abc"), TestPatch(3, 0, "de"),
+                   TestPatch(1, 2, ""), TestPatch(0, 0, "zz")]
+        ops_u, _ = B.compile_local_patches(patches, lmax=8)
+        ops_f, _ = B.compile_local_patches(patches, lmax=8, fuse_w=8)
+        for name in ops_u.__dataclass_fields__:
+            assert np.array_equal(np.asarray(getattr(ops_u, name)),
+                                  np.asarray(getattr(ops_f, name))), name
+
+    def test_fuse_respects_lmax(self):
+        # lmax // L < 2 -> no fusion even for a perfect burst.
+        patches = [TestPatch(0, 0, "abcde")] * 4
+        ops, _ = B.compile_local_patches(patches, lmax=8, fuse_w=8)
+        assert B.fused_width(ops) == 1
+        assert ops.num_steps == 4
+
+    def test_row_growth_bound_ops(self):
+        patches = [TestPatch(0, 0, "x")] * 8
+        ops, _ = B.compile_local_patches(patches, lmax=8, fuse_w=4)
+        assert B.row_growth_bound_ops(ops) == 1 + 2 * (4 + 1)
+        ops_u, _ = B.compile_local_patches(patches, lmax=8)
+        assert B.row_growth_bound_ops(ops_u) == B.row_growth_bound(8)
+
+    def test_unfused_engines_reject_fused_streams(self):
+        from text_crdt_rust_tpu.ops import rle_lanes as RL
+        patches = [TestPatch(0, 0, "x")] * 4
+        ops, _ = B.compile_local_patches(patches, lmax=4, fuse_w=4)
+        with pytest.raises(ValueError, match="fused"):
+            F.apply_ops(SA.make_flat_doc(64), ops)
+        with pytest.raises(ValueError, match="fused"):
+            RL.replay_lanes(B.stack_ops([ops]), capacity=64,
+                            interpret=True)
+        # ...and the fused engines bound W by the one-split headroom.
+        with pytest.raises(ValueError, match="headroom"):
+            R.replay_local_rle(ops, capacity=64, batch=8, block_k=8,
+                               chunk=32, interpret=True)
+
+    def test_registry_fused_flag(self):
+        from text_crdt_rust_tpu.config import supports_fused_steps
+        assert supports_fused_steps("rle")
+        assert supports_fused_steps("rle-hbm")
+        assert supports_fused_steps("rle-hbm-fused")  # row alias
+        assert not supports_fused_steps("flat")
+        assert not supports_fused_steps("rle-lanes-mixed")
+        assert not supports_fused_steps("native-cpp")
+
+
+class TestFusedKernels:
+    def test_kevin_shape_vmem_and_hbm(self):
+        # Pure prepends: every step is a full-width fused splice; the
+        # final doc order must read N-1..0 (orders reversed).
+        n = 126  # a whole number of FW-wide bursts, <= SMAX unfused
+        patches = [TestPatch(0, 0, "k")] * n
+        ops_u, ops_f = _compile_pair(patches, fuse_w=FW, lmax=FW)
+        want = np.arange(n, 0, -1, dtype=np.int32)
+        for mk in (R.replay_local_rle, RH.replay_local_rle_hbm):
+            res_u = mk(ops_u, **GEOM)
+            res_f = mk(ops_f, **GEOM)
+            du, df = _assert_equivalent(ops_u, ops_f, res_u, res_f,
+                                        content="k" * n)
+            assert np.array_equal(R.expand_runs(res_f), want)
+        # The point of the exercise: ~W x fewer device steps.
+        live_u = int((np.asarray(ops_u.ins_len) > 0).sum())
+        live_f = int((np.asarray(ops_f.ins_len) > 0).sum())
+        assert live_f * FW == live_u
+
+    def test_fused_boundary_exactly_at_block_split(self):
+        # Fill slot 0 to KF-FW rows (prepends of distinct chars cannot
+        # merge), then one full-width burst: r0 + FW + 1 > KF fires the
+        # leaf split and the fused splice lands across the fresh block
+        # boundary.  Unfused stream splits at a DIFFERENT row boundary —
+        # the logical expansion must still match exactly.
+        pre = KF - FW
+        patches = [TestPatch(0, 0, "p")] * pre \
+            + [TestPatch(0, 0, "b")] * FW + [TestPatch(0, 0, "t")]
+        ops_u, ops_f = _compile_pair(patches, fuse_w=FW, lmax=FW)
+        res_u = R.replay_local_rle(ops_u, **GEOM)
+        res_f = R.replay_local_rle(ops_f, **GEOM)
+        _assert_equivalent(ops_u, ops_f, res_u, res_f,
+                           content="t" + "b" * FW + "p" * pre)
+        assert int(np.asarray(res_f.meta)[0].max()) >= 2, \
+            "burst never crossed a block split — geometry drifted"
+
+    def test_fuzz_mixed_streams_bit_identity(self):
+        # Mixed prepend/typing/delete streams at one fixed shape, VMEM
+        # engine, vs the flat-engine per-keystroke oracle.  3 seeds in
+        # tier-1 (the 794s-of-870s budget is nearly spent); the deep
+        # sweep + the HBM ride-along run in ``slow``.
+        for seed in range(3):
+            rng = random.Random(seed)
+            patches, content = burst_patches(rng, 60)
+            ops_u, ops_f = _compile_pair(patches)
+            res_u = R.replay_local_rle(ops_u, **GEOM)
+            res_f = R.replay_local_rle(ops_f, **GEOM)
+            du, df = _assert_equivalent(ops_u, ops_f, res_u, res_f,
+                                        content=content)
+            ref = F.apply_ops(SA.make_flat_doc(1024), ops_u)
+            assert SA.doc_spans(df) == SA.doc_spans(ref), seed
+
+@pytest.mark.slow
+class TestFusedDeep:
+    def test_fuzz_hbm_ride_along(self):
+        # Mixed streams through the HBM window engine (the kevin
+        # engine); tier-1 already proves its fused splice on the kevin
+        # shape in test_kevin_shape_vmem_and_hbm.
+        for seed in range(2):
+            rng = random.Random(100 + seed)
+            patches, content = burst_patches(rng, 60)
+            ops_u, ops_f = _compile_pair(patches)
+            res_u = RH.replay_local_rle_hbm(ops_u, **GEOM)
+            res_f = RH.replay_local_rle_hbm(ops_f, **GEOM)
+            _assert_equivalent(ops_u, ops_f, res_u, res_f,
+                               content=content)
+
+    def test_fuzz_deep(self):
+        for seed in range(4, 40):
+            rng = random.Random(seed)
+            patches, content = burst_patches(rng, 60)
+            ops_u, ops_f = _compile_pair(patches)
+            res_u = R.replay_local_rle(ops_u, **GEOM)
+            res_f = R.replay_local_rle(ops_f, **GEOM)
+            du, df = _assert_equivalent(ops_u, ops_f, res_u, res_f,
+                                        content=content)
+            ref = F.apply_ops(SA.make_flat_doc(1024), ops_u)
+            assert SA.doc_spans(df) == SA.doc_spans(ref), seed
+
+    def test_kevin_at_scale(self):
+        # The acceptance shape: a long pure-prepend stream at the bench
+        # fuse width, fused-vs-unfused on the HBM engine + the analytic
+        # oracle (orders must read N-1..0).  5M is a silicon workload;
+        # this is the largest CPU-interpret size that stays in budget.
+        n = 8192
+        w = 64
+        patches = [TestPatch(0, 0, " ")] * n
+        ops_u, _ = B.compile_local_patches(patches, lmax=w)
+        ops_f, _ = B.compile_local_patches(patches, lmax=w, fuse_w=w)
+        assert ops_f.num_steps == n // w
+        kw = dict(capacity=((n * 21 // 10) // 256 + 1) * 256, batch=8,
+                  block_k=256, chunk=128, interpret=True)
+        res_u = RH.replay_local_rle_hbm(ops_u, **kw)
+        res_f = RH.replay_local_rle_hbm(ops_f, **kw)
+        want = np.arange(n, 0, -1, dtype=np.int32)
+        assert np.array_equal(R.expand_runs(res_f), want)
+        assert np.array_equal(R.expand_runs(res_u), want)

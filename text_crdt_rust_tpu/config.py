@@ -21,8 +21,15 @@ from typing import Optional, Sequence
 # derives from this dict; ``tests/test_engine_registry.py`` asserts the
 # README table and bench.py name no engine outside it.
 ENGINE_REGISTRY = {
-    "rle":             {"module": "ops.rle", "configs": ("northstar", "2", "3")},
-    "rle-hbm":         {"module": "ops.rle_hbm", "configs": ("northstar", "kevin")},
+    # ``fused_steps``: the engine's insert splice accepts FUSED multi-row
+    # steps (``rows_per_step`` W > 1, the split-batch prepare for the
+    # kevin prepend worst case).  Streams compiled with ``fuse_w`` > 1
+    # may only run on engines carrying this flag; every other engine
+    # rejects them at build time.
+    "rle":             {"module": "ops.rle", "configs": ("northstar", "2", "3"),
+                        "fused_steps": True},
+    "rle-hbm":         {"module": "ops.rle_hbm", "configs": ("northstar", "kevin"),
+                        "fused_steps": True},
     "rle-lanes":       {"module": "ops.rle_lanes", "configs": ("5",)},
     "rle-mixed":       {"module": "ops.rle_mixed", "configs": ("4",)},
     # The blocked per-lane mixed engine serves two surfaces: the config
@@ -53,6 +60,7 @@ ENGINE_CHOICES = tuple(ENGINE_REGISTRY)
 # through this map — any NEW label must land here or in the registry.
 ENGINE_ROW_ALIASES = {
     "rle-groups": "rle",       # config 3: rle engine, doc-group grid axis
+    "rle-hbm-fused": "rle-hbm",  # kevin: fused multi-row prepare steps
     "native-cpp": None,        # host C++ baseline
     "gap-buffer": None,        # text-only rope lower bound
 }
@@ -64,6 +72,36 @@ def engines_for(config_key: str) -> tuple:
     private literal tuples."""
     return tuple(n for n, spec in ENGINE_REGISTRY.items()
                  if config_key in spec["configs"])
+
+
+def supports_fused_steps(engine: str) -> bool:
+    """True when ``engine`` (registry name or row alias) carries the
+    ``fused_steps`` W-row insert splice — the single source the bench
+    and compile plumbing consult before compiling with ``fuse_w`` > 1."""
+    name = ENGINE_ROW_ALIASES.get(engine, engine)
+    if name is None:
+        return False
+    return bool(ENGINE_REGISTRY.get(name, {}).get("fused_steps", False))
+
+
+@dataclasses.dataclass
+class BatchConfig:
+    """Host-side op-compiler knobs (``ops.batch``) — the compile-side
+    twin of ``EngineConfig``; CLIs that shape op streams construct one
+    (bench's kevin path) so the compile call sites share these
+    defaults rather than growing private literal forests.
+
+    ``fuse_w`` is the split-batch prepare width: backwards-contiguous
+    insert bursts (the kevin prepend shape) compile into fused
+    ``rows_per_step <= fuse_w`` steps — one device step splices the
+    whole burst.  Requires a ``fused_steps`` engine and
+    ``fuse_w <= block_k // 2 - 1`` (one leaf split must make room for
+    a full fused step); 1 disables fusion.
+    """
+
+    lmax: int = 16             # insert-chunk width of compiled steps
+    dmax: Optional[int] = None  # per-step delete-span bound (None = off)
+    fuse_w: int = 1            # fused insert-burst width (1 = unfused)
 
 
 def lane_block_geometry(capacity: int, block_k: int) -> tuple:
@@ -148,7 +186,11 @@ class ServeConfig:
     #                            rle-lanes-mixed backend; smaller K than
     #                            the config-5/5r replays because serve
     #                            steps are tiny edits and NBT+K is the
-    #                            per-step touched-row floor (PERF.md §10)
+    #                            per-step touched-row floor (PERF.md §10).
+    #                            32 is the serve-tuned sweep winner
+    #                            (perf/serve_k_sweep.json: min touched
+    #                            rows/step over K in {8,16,32,64} on the
+    #                            loadgen tick trace)
     interpret: Optional[bool] = None  # pallas interpreter for the lanes
     #                            backend (None = auto: on unless on TPU)
     lmax: int = 8              # insert-chunk width of compiled serve steps

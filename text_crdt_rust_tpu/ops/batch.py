@@ -53,7 +53,8 @@ KIND_REMOTE_DEL = 2   # tombstone an order-contiguous target range
     jax.tree_util.register_dataclass,
     data_fields=[
         "kind", "pos", "del_len", "del_target", "origin_left", "origin_right",
-        "ins_len", "ins_order_start", "order_advance", "rank", "chars",
+        "ins_len", "ins_order_start", "order_advance", "rank",
+        "rows_per_step", "chars",
     ],
     meta_fields=[],
 )
@@ -72,6 +73,11 @@ class OpTensors:
     ins_order_start: jax.Array  # u32[S, ...]   first order of the insert run
     order_advance: jax.Array    # u32[S, ...]   orders consumed by this step
     rank: jax.Array             # u32[S, ...]   author agent's name rank
+    rows_per_step: jax.Array    # u32[S, ...]   W: run rows this step splices
+    #   (1 = plain op; W > 1 = a FUSED backwards-contiguous insert burst:
+    #   W same-length runs spliced in one step, orders DESCENDING in doc
+    #   order with stride L = ins_len/W — the split-batch prepare for the
+    #   kevin prepend shape. 0 only on no-op padding rows.)
     chars: jax.Array            # u32[S, ..., LMAX]
 
     @property
@@ -240,9 +246,10 @@ class _Rows:
 
     def emit(self, *, kind=0, pos=0, del_len=0, del_target=0,
              origin_left=ROOT_ORDER, origin_right=ROOT_ORDER, ins_len=0,
-             ins_order_start=0, order_advance=0, rank=0,
+             ins_order_start=0, order_advance=0, rank=0, rows=1,
              content: str = "") -> None:
         assert ins_len <= self.lmax
+        assert rows >= 1 and (rows == 1 or ins_len % rows == 0)
         cps = np.zeros(self.lmax, dtype=np.uint32)
         if content:
             assert len(content) == ins_len
@@ -257,6 +264,7 @@ class _Rows:
         c["ins_order_start"].append(ins_order_start)
         c["order_advance"].append(order_advance)
         c["rank"].append(rank)
+        c["rows_per_step"].append(rows)
         c["chars"].append(cps)
 
     def to_tensors(self) -> OpTensors:
@@ -324,12 +332,67 @@ def merge_patches(patches: Sequence[TestPatch]) -> List[TestPatch]:
     return out
 
 
+def fused_width(ops: OpTensors) -> int:
+    """Max ``rows_per_step`` of a compiled stream (1 for empty streams).
+    Engines without W-row splice support gate on this; the fused
+    engines size their shift bound and block headroom from it."""
+    r = np.asarray(ops.rows_per_step)
+    return max(int(r.max()) if r.size else 1, 1)
+
+
+def require_unfused(ops: OpTensors, engine: str) -> None:
+    """The ONE reject guard for engines without the W-row splice (every
+    engine except ops.rle / ops.rle_hbm calls this at build time — a
+    fused stream on an unfused engine would silently misapply, its row
+    columns read as one wide plain insert)."""
+    if fused_width(ops) > 1:
+        raise ValueError(
+            f"{engine} has no fused multi-row splice; compile with "
+            f"fuse_w=1 (fused streams run on ops.rle / ops.rle_hbm)")
+
+
+def fused_width_checked(streams, block_k: int) -> int:
+    """WMAX of a stream set, validated against the fused engines' ONE
+    rule: ``WMAX <= K//2 - 1`` — a freshly split block holds up to
+    ceil(K/2) rows and must fit W new rows + one split tail, so a
+    single amortized-O(1) leaf split always makes room for a fused
+    step.  Shared by ops.rle / ops.rle_hbm so the headroom contract
+    cannot drift between them."""
+    wmax = max(fused_width(st) for st in streams)
+    if wmax > 1 and wmax > block_k // 2 - 1:
+        raise ValueError(
+            f"fused rows_per_step {wmax} exceeds the one-split headroom "
+            f"of block_k {block_k} (need WMAX <= K//2 - 1: a freshly "
+            f"split block holds up to ceil(K/2) rows and must fit W+1 "
+            f"more)")
+    return wmax
+
+
+def _burst_len(patches: Sequence[TestPatch], i: int) -> int:
+    """Length of the maximal backwards-contiguous insert burst starting
+    at patch ``i``: consecutive insert-only patches at the SAME document
+    position with EQUAL insert lengths (the kevin prepend shape — each
+    patch's text lands immediately BEFORE the previous patch's, so the
+    relative run layout is statically known)."""
+    p0 = patches[i]
+    if p0.del_len or not p0.ins_content:
+        return 1
+    L = len(p0.ins_content)
+    j = i + 1
+    while (j < len(patches) and not patches[j].del_len
+           and len(patches[j].ins_content) == L
+           and patches[j].pos == p0.pos):
+        j += 1
+    return j - i
+
+
 def compile_local_patches(
     patches: Sequence[TestPatch],
     rank: int = 0,
     lmax: int = 16,
     start_order: int = 0,
     dmax: Optional[int] = None,
+    fuse_w: int = 1,
 ) -> Tuple[OpTensors, int]:
     """Single-author local edit stream -> op tensors.
 
@@ -338,11 +401,53 @@ def compile_local_patches(
     numbers, then the insert run). ``dmax`` additionally chunks deletes
     (the blocked engine bounds per-step delete spans; the flat engine's
     live-rank window op handles any span, so None = unchunked).
+
+    ``fuse_w > 1`` enables SPLIT-BATCH PREPARE: a backwards-contiguous
+    insert burst (``_burst_len``) is compiled into fused multi-row
+    steps of up to ``fuse_w`` patches each — ONE device step splicing W
+    pre-built run rows (descending orders, stride L) instead of W
+    steps.  Semantically identical to the unfused stream: orders,
+    chars, and origins are unchanged (patch k's origin_left is the
+    shared left neighbour, its origin_right is patch k-1's head — the
+    successor at its insert time), and the engines' expanded state is
+    bit-identical (a burst never exercises the in-kernel append-merge:
+    only the burst's FIRST patch could merge, and the second patch's
+    splice would split that merged run at the exact same boundary the
+    unfused stream does).  Only the fused engines (``ENGINE_REGISTRY``
+    entries with ``fused_steps``) accept W > 1 streams.
     """
     assert dmax is None or dmax >= 1, f"dmax must be >= 1, got {dmax}"
+    assert fuse_w >= 1, f"fuse_w must be >= 1, got {fuse_w}"
     rows = _Rows(lmax)
     next_order = start_order
-    for p in patches:
+    patches = list(patches)
+    i = 0
+    while i < len(patches):
+        p = patches[i]
+        L = len(p.ins_content)
+        w_cap = min(fuse_w, lmax // L) if L else 1
+        # Scan for a burst only when one could actually fuse — an
+        # unfusable shape (w_cap < 2) must not re-walk the remaining
+        # run from every index (quadratic on long uniform streams).
+        burst = _burst_len(patches, i) if (fuse_w > 1 and w_cap >= 2) \
+            else 1
+        if burst >= 2 and w_cap >= 2:
+            while burst > 0:
+                w = min(w_cap, burst)
+                group = patches[i:i + w]
+                # Chars are ORDER-major (patch k at [k*L, (k+1)*L)); the
+                # device splices the rows in reverse patch order.
+                rows.emit(
+                    kind=KIND_LOCAL, pos=p.pos, ins_len=w * L,
+                    ins_order_start=next_order, order_advance=w * L,
+                    rank=rank, rows=w,
+                    content="".join(g.ins_content for g in group),
+                )
+                next_order += w * L
+                burst -= w
+                i += w
+            continue
+        i += 1
         ins = p.ins_content
         first_chunk = ins[:lmax]
         dfirst = p.del_len if dmax is None else min(p.del_len, dmax)
@@ -470,6 +575,7 @@ def _prefill_scatter(ops: OpTensors):
     ranks = np.asarray(ops.rank)
     ol_ops = np.asarray(ops.origin_left)
     or_ops = np.asarray(ops.origin_right)
+    wsteps = np.maximum(np.asarray(ops.rows_per_step, dtype=np.int64), 1)
 
     sel = ins_len > 0
     if not sel.any():
@@ -484,7 +590,11 @@ def _prefill_scatter(ops: OpTensors):
     # Within-run implicit origin chain (`span.rs:9-13,24-28`): item k's
     # origin_left is order+k-1. The run head's origins are known at compile
     # time only for remote inserts; local heads are written on device.
-    chain = within > 0
+    # A FUSED step carries rows_per_step sub-runs of stride L = il/W —
+    # the chain breaks at every sub-run head (their origins come from the
+    # device/host fused-origin merge, `rle.rle_to_flat`).
+    stride = np.repeat(ins_len[sel] // wsteps[sel], reps)
+    chain = (within % stride) != 0
     remote = kinds[step_idx] == KIND_REMOTE_INS
     head = ~chain & remote
     return {
@@ -563,6 +673,16 @@ def row_growth_bound(num_steps: int) -> int:
     NB-per-chunk sizing) derive from this exact invariant — no sampling
     (PERF.md §7.2/§9)."""
     return 1 + 2 * num_steps
+
+
+def row_growth_bound_ops(ops: OpTensors) -> int:
+    """Fused-aware sound row bound for ONE compiled stream: a plain step
+    splices at most 2 new rows (see ``row_growth_bound``); a fused
+    W-row step splices at most W + 1 (W new runs + one split tail).
+    Equals ``row_growth_bound(num_steps)`` on unfused streams."""
+    w = np.maximum(
+        np.asarray(ops.rows_per_step, dtype=np.int64).reshape(-1), 1)
+    return 1 + int(np.maximum(2, w + 1).sum())
 
 
 # -- batching ----------------------------------------------------------------

@@ -46,7 +46,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..common import ROOT_ORDER
-from .batch import KIND_LOCAL, OpTensors, prefill_logs
+from .batch import KIND_LOCAL, OpTensors, prefill_logs, require_unfused
 from .flat import _order_of
 from .span_arrays import FlatDoc, I32, U32, make_flat_doc
 
@@ -354,6 +354,7 @@ def make_replayer(
     _require(kinds.ndim == 1, "blocked engine takes one shared stream")
     _require(bool((kinds == KIND_LOCAL).all()),
              "blocked engine replays local streams; remote ops -> ops.flat")
+    require_unfused(ops, "the blocked engine")
     _require(capacity % block_k == 0,
              f"capacity ({capacity}) must be a multiple of block_k "
              f"({block_k})")

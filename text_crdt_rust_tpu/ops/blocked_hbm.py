@@ -34,7 +34,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..common import ROOT_ORDER
-from .batch import KIND_LOCAL, OpTensors
+from .batch import KIND_LOCAL, OpTensors, require_unfused
 from .blocked import (
     BlockedResult,
     _cumsum_rows,
@@ -354,6 +354,7 @@ def make_replayer_hbm(
                  "hbm engine replays local streams; remote ops -> "
                  "ops.blocked_mixed / ops.flat")
         _require(st.lmax == lmax, "all groups must share one lmax")
+        require_unfused(st, "the blocked-hbm engine")
     _require(capacity % block_k == 0,
              f"capacity ({capacity}) must be a multiple of block_k "
              f"({block_k})")

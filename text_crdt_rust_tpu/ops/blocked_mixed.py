@@ -49,6 +49,7 @@ from .batch import (
     KIND_REMOTE_INS,
     OpTensors,
     prefill_logs,
+    require_unfused,
 )
 from .blocked import (
     BlockedResult,
@@ -361,6 +362,7 @@ def make_replayer_mixed(
     """
     kinds = np.asarray(ops.kind)
     _require(kinds.ndim == 1, "blocked engine takes one shared stream")
+    require_unfused(ops, "the blocked-mixed engine")
     _require(capacity % block_k == 0,
              f"capacity ({capacity}) must be a multiple of block_k "
              f"({block_k})")

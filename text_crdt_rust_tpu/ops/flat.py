@@ -40,7 +40,13 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..common import ROOT_ORDER
-from .batch import KIND_LOCAL, KIND_REMOTE_DEL, KIND_REMOTE_INS, OpTensors
+from .batch import (
+    KIND_LOCAL,
+    KIND_REMOTE_DEL,
+    KIND_REMOTE_INS,
+    OpTensors,
+    require_unfused,
+)
 from .span_arrays import FlatDoc, I32, U32
 
 # numpy (not jnp) scalar: a module-level jnp constant would initialize the
@@ -244,6 +250,7 @@ def _check_capacity(doc: FlatDoc, ops: OpTensors) -> None:
     (the serve batcher's shape) each lane's own occupancy pairs with its
     own stream's growth — a full lane with no traffic must not fail the
     check on behalf of an empty lane with a long stream."""
+    require_unfused(ops, "the flat engine")
     need = np.asarray(doc.n) + np.asarray(ops.ins_len).sum(axis=0)
     assert int(np.max(need)) <= doc.capacity, (
         f"op stream needs {int(np.max(need))} rows but capacity is "

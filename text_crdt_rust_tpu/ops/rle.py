@@ -20,6 +20,11 @@ instead of 259,778 steps over 524k rows:
 - an insert touches ≤3 rows (split + new run + tail) NO MATTER HOW LONG
   the inserted text is — the per-op cost is independent of ``ins_len``,
   which is what makes the merged stream pay off;
+- a FUSED step (``rows_per_step`` W > 1, compiled by
+  ``batch.compile_local_patches(fuse_w=W)`` from backwards-contiguous
+  insert bursts — the kevin prepend shape the forward coalescer can't
+  touch) splices W descending-order runs in ONE shift: W ops' worth of
+  work per sequential device step (PERF.md §11);
 - a delete flips sign on covered runs and splits at most the two
   boundary runs (`mutations.rs:520-570` semantics, tombstones =
   sign-flip per `span.rs:110-119`);
@@ -54,7 +59,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..common import ROOT_ORDER
-from .batch import KIND_LOCAL, OpTensors, prefill_logs
+from .batch import KIND_LOCAL, OpTensors, fused_width_checked, prefill_logs
 from .blocked import _cumsum_rows, _lane_scalar, _require, _shift_rows
 from .span_arrays import FlatDoc, I32, U32, make_flat_doc
 
@@ -94,28 +99,46 @@ def _locate_run(bo, bl, idx_k, r0, local):
     return i_r, o_r, l_r, off
 
 
-def _insert_splice(bo, bl, idx_k, p, i_r, o_r, l_r, off, il, st):
+def _insert_splice(bo, bl, idx_k, p, i_r, o_r, l_r, off, il, st,
+                   w=None, wmax: int = 1):
     """In-register insert splice (`mutations.rs:17-179`): ≤3 touched rows
     regardless of ``il``. Returns ``(no, nl, amt, mrg, is_split)`` —
     the new block planes, rows added, and which path was taken.
+
+    ``w``/``wmax`` extend the splice to FUSED multi-row steps
+    (``batch.compile_local_patches`` ``fuse_w``): ``w`` run rows of
+    stride ``L = il // w`` land in ONE shift — row j of the spliced
+    window holds orders ``st + il - (j+1)*L`` (patch order DESCENDS in
+    document order: a same-position burst prepends each patch before
+    the previous one).  ``w == 1`` reduces to the plain splice exactly
+    (one row, order ``st``, length ``il``).  The in-kernel append-merge
+    stays w==1-only: a fused burst's first patch merging would be
+    un-done by its second patch's split at the same boundary, so
+    skipping it keeps the expanded state bit-identical to the unfused
+    stream (see the compile-side proof note).  ``wmax`` is the static
+    shift bound (max w of the stream).
 
     The in-place merge path is device-state compaction only (an
     order-contiguous live extension of run ``i_r``); YjsSpan merge
     predicates live host-side — this run is raw doc order.
     """
-    mrg = (p > 0) & (off == l_r) & ((st + 1) == (o_r + l_r))
+    if w is None:
+        w = jnp.int32(1)
+    lrun = il // jnp.maximum(w, 1)
+    mrg = (w == 1) & (p > 0) & (off == l_r) & ((st + 1) == (o_r + l_r))
     is_split = (p > 0) & (off < l_r)
     ins_at = jnp.where(p == 0, 0, i_r + 1)
-    amt = jnp.where(mrg, 0, jnp.where(is_split, 2, 1))
-    so = _shift_rows(bo, amt, 2)
-    sl = _shift_rows(bl, amt, 2)
+    amt = jnp.where(mrg, 0, w + is_split.astype(jnp.int32))
+    so = _shift_rows(bo, amt, wmax + 1)
+    sl = _shift_rows(bl, amt, wmax + 1)
     no = jnp.where(idx_k < ins_at, bo, so)
     nl = jnp.where(idx_k < ins_at, bl, sl)
     nl = jnp.where(is_split & (idx_k == i_r), off, nl)
-    new_run = (idx_k == ins_at) & jnp.logical_not(mrg)
-    no = jnp.where(new_run, st + 1, no)
-    nl = jnp.where(new_run, il, nl)
-    tail = is_split & (idx_k == ins_at + 1)
+    new_run = (idx_k >= ins_at) & (idx_k < ins_at + w) & \
+        jnp.logical_not(mrg)
+    no = jnp.where(new_run, st + il - (idx_k - ins_at + 1) * lrun + 1, no)
+    nl = jnp.where(new_run, lrun, nl)
+    tail = is_split & (idx_k == ins_at + w)
     no = jnp.where(tail, o_r + off, no)
     nl = jnp.where(tail, l_r - off, nl)
     nl = jnp.where(mrg & (idx_k == i_r), l_r + il, nl)
@@ -221,13 +244,14 @@ def _delete_block_math(bo, bl, idx_k, K, base, p, rem, aux=None):
 
 def _rle_kernel(
     pos_ref, dlen_ref, ilen_ref, start_ref,     # [CHUNK] SMEM op columns
+    w_ref,                                      # [CHUNK] SMEM rows_per_step
     ol_ref, or_ref,                             # [1,CHUNK,B] VMEM outputs
     ordp, lenp,                                 # [CAP,B] state planes (OUT
                                                 #   blocks used as working
                                                 #   state — halves VMEM)
     blk_out, rows_out, meta_out, err_ref,       # tables + flags
     blkord, rws, liv, cumliv, meta,             # persistent scratch
-    *, K: int, NB: int, NBL: int, CHUNK: int,
+    *, K: int, NB: int, NBL: int, CHUNK: int, WMAX: int,
 ):
     B = ordp.shape[1]
     g = pl.program_id(0)
@@ -332,12 +356,15 @@ def _rle_kernel(
         l = jnp.where(p == 0, 0, slot_of_live_rank(p))
         return l, slot_scalar(rws, l)
 
-    def do_insert(k, p, il, st):
-        """Insert an ``il``-char run after live rank ``p``
-        (`mutations.rs:17-179`): ≤3 touched rows regardless of ``il``."""
+    def do_insert(k, p, il, st, w):
+        """Insert an ``il``-char run (or, fused, ``w`` runs of stride
+        ``il//w``) after live rank ``p`` (`mutations.rs:17-179`):
+        ≤ w+2 touched rows regardless of ``il``.  One split always
+        makes room: the builder enforces WMAX <= K//2 - 1, so a
+        freshly-split slot (≤ ⌈K/2⌉ rows) fits w+1 more."""
         l, r0 = find_insert_slot(p)
 
-        @pl.when(r0 + 2 > K)
+        @pl.when(r0 + w + 1 > K)
         def _():
             split(l)
 
@@ -349,7 +376,7 @@ def _rle_kernel(
         bl = lenp[pl.ds(b * K, K), :]
         i_r, o_r, l_r, off = _locate_run(bo, bl, idx_k, r0, local)
         no, nl, amt, _mrg, is_split = _insert_splice(
-            bo, bl, idx_k, p, i_r, o_r, l_r, off, il, st)
+            bo, bl, idx_k, p, i_r, o_r, l_r, off, il, st, w, WMAX)
 
         left = jnp.where(p == 0, root_u,
                          ((o_r - 1) + (off - 1)).astype(jnp.uint32))
@@ -420,6 +447,7 @@ def _rle_kernel(
         d = dlen_ref[k]
         il = ilen_ref[k]
         st = start_ref[k]
+        w = jnp.maximum(w_ref[k], 1)  # no-op pad rows carry 0
 
         @pl.when(d > 0)
         def _():
@@ -427,7 +455,7 @@ def _rle_kernel(
 
         @pl.when(il > 0)
         def _():
-            do_insert(k, p, il, st)
+            do_insert(k, p, il, st, w)
 
         return 0
 
@@ -506,6 +534,7 @@ def make_replayer_rle(
     _require(NB >= 1, "need at least one block")
     _require(block_k >= 8, "block_k must hold a few runs")
     NBLp = max(8, NB)
+    WMAX = fused_width_checked(streams, block_k)
 
     lens = [st.num_steps for st in streams]
     s_pad = max(((max(lens) + chunk - 1) // chunk) * chunk, chunk)
@@ -523,7 +552,8 @@ def make_replayer_rle(
     staged = (staged_col(lambda o: o.pos),
               staged_col(lambda o: o.del_len),
               staged_col(lambda o: o.ins_len),
-              staged_col(lambda o: o.ins_order_start))
+              staged_col(lambda o: o.ins_order_start),
+              staged_col(lambda o: o.rows_per_step))
 
     blocks_per_g = s_pad // chunk
     smem = lambda: pl.BlockSpec(
@@ -531,9 +561,10 @@ def make_replayer_rle(
         memory_space=pltpu.SMEM)
 
     call = pl.pallas_call(
-        partial(_rle_kernel, K=block_k, NB=NB, NBL=NBLp, CHUNK=chunk),
+        partial(_rle_kernel, K=block_k, NB=NB, NBL=NBLp, CHUNK=chunk,
+                WMAX=WMAX),
         grid=(G, s_pad // chunk),
-        in_specs=[smem(), smem(), smem(), smem()],
+        in_specs=[smem(), smem(), smem(), smem(), smem()],
         out_specs=[
             pl.BlockSpec((1, chunk, batch), lambda g, i: (g, i, 0),
                          memory_space=pltpu.VMEM),
@@ -574,7 +605,7 @@ def make_replayer_rle(
         ),
         interpret=interpret,
     )
-    jitted = jax.jit(lambda a, b, c, d: call(a, b, c, d))
+    jitted = jax.jit(lambda a, b, c, d, e: call(a, b, c, d, e))
 
     def run():
         ol, orr, ordp, lenp, blk, rows, meta, err = jitted(*staged)
@@ -718,10 +749,19 @@ def rle_to_flat(
             f"steps but the result carries {len(ol_np)} — was the engine "
             "built with store_origins=False? (zip truncation would "
             "silently skip the origin merges)")
-    for st, il, left, right in zip(starts, ilens, ol_np, or_np):
+    ws = np.maximum(
+        np.asarray(ops.rows_per_step, dtype=np.int64), 1)
+    for st, il, w, left, right in zip(starts, ilens, ws, ol_np, or_np):
         if il > 0:
-            ol_log[st] = left
-            or_log[st: st + il] = right
+            # A fused step's kernel origins are patch 0's (left is
+            # SHARED by every patch of the burst; rights chain
+            # statically: patch k's raw successor at insert time is
+            # patch k-1's head, order st + (k-1)*L).
+            L = il // w
+            for k in range(w):
+                ol_log[st + k * L] = left
+                or_log[st + k * L: st + (k + 1) * L] = (
+                    right if k == 0 else st + (k - 1) * L)
 
     signed_col = np.zeros(capacity, np.int32)
     signed_col[:n] = flat

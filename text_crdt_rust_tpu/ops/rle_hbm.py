@@ -36,7 +36,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..common import ROOT_ORDER
-from .batch import KIND_LOCAL
+from .batch import KIND_LOCAL, fused_width_checked
 from .blocked import _cumsum_rows, _require, _shift_rows
 from .rle import (
     RleResult,
@@ -52,13 +52,14 @@ SUP = 64  # logical slots per super-segment (level-2 live index fan-out)
 
 def _rle_hbm_kernel(
     pos_ref, dlen_ref, ilen_ref, start_ref,     # [CHUNK] SMEM op columns
+    w_ref,                                      # [CHUNK] SMEM rows_per_step
     ol_ref, or_ref,                             # [1,CHUNK,B] VMEM outputs
     ordp, lenp,                                 # [G*CAP,B] ANY/HBM planes
     blk_out, rows_out, meta_out, err_ref,       # tables + flags
     wo, wl, stage,                              # [K,B] window + DMA stage
     blkord, rws, liv, supliv,                   # logical tables (VMEM)
     wmeta, meta, sem,                           # SMEM scalars + DMA sem
-    *, K: int, NB: int, NBL: int, NSUP: int, CHUNK: int,
+    *, K: int, NB: int, NBL: int, NSUP: int, CHUNK: int, WMAX: int,
 ):
     B = wo.shape[1]
     g = pl.program_id(0)
@@ -203,10 +204,10 @@ def _rle_hbm_kernel(
         l = jnp.where(p == 0, 0, slot_of_live_rank(p))
         return l, slot_scalar(rws, l)
 
-    def do_insert(k, p, il, st):
+    def do_insert(k, p, il, st, w):
         l, r0 = find_insert_slot(p)
 
-        @pl.when(r0 + 2 > K)
+        @pl.when(r0 + w + 1 > K)
         def _():
             split(l)
 
@@ -247,7 +248,7 @@ def _rle_hbm_kernel(
                           (jnp.abs(succ) - 1).astype(jnp.uint32))
 
         no, nl, amt, _mrg, _sp = _insert_splice(
-            bo, bl, idx_k, p, i_r, o_r, l_r, off, il, st)
+            bo, bl, idx_k, p, i_r, o_r, l_r, off, il, st, w, WMAX)
         wo[:] = no
         wl[:] = nl
         rws[pl.ds(l, 1), :] = rws[pl.ds(l, 1), :] + amt
@@ -289,6 +290,7 @@ def _rle_hbm_kernel(
         d = dlen_ref[k]
         il = ilen_ref[k]
         st = start_ref[k]
+        w = jnp.maximum(w_ref[k], 1)  # no-op pad rows carry 0
 
         @pl.when(d > 0)
         def _():
@@ -296,7 +298,7 @@ def _rle_hbm_kernel(
 
         @pl.when(il > 0)
         def _():
-            do_insert(k, p, il, st)
+            do_insert(k, p, il, st, w)
 
         return 0
 
@@ -353,6 +355,7 @@ def make_replayer_rle_hbm(
     NB = capacity // block_k
     _require(NB >= 1, "need at least one block")
     _require(block_k >= 8, "block_k must hold a few runs")
+    WMAX = fused_width_checked(streams, block_k)
     NSUP = (NB + SUP - 1) // SUP
     NBLp = NSUP * SUP
     NSUPp = max(8, NSUP)
@@ -370,7 +373,8 @@ def make_replayer_rle_hbm(
     staged = (staged_col(lambda o: o.pos),
               staged_col(lambda o: o.del_len),
               staged_col(lambda o: o.ins_len),
-              staged_col(lambda o: o.ins_order_start))
+              staged_col(lambda o: o.ins_order_start),
+              staged_col(lambda o: o.rows_per_step))
 
     blocks_per_g = s_pad // chunk
     smem = lambda: pl.BlockSpec(
@@ -383,9 +387,9 @@ def make_replayer_rle_hbm(
 
     call = pl.pallas_call(
         partial(_rle_hbm_kernel, K=block_k, NB=NB, NBL=NBLp, NSUP=NSUP,
-                CHUNK=chunk),
+                CHUNK=chunk, WMAX=WMAX),
         grid=(G, blocks_per_g),
-        in_specs=[smem(), smem(), smem(), smem()],
+        in_specs=[smem(), smem(), smem(), smem(), smem()],
         out_specs=[
             pl.BlockSpec((1, chunk, batch), o_map,
                          memory_space=pltpu.VMEM),
@@ -429,7 +433,7 @@ def make_replayer_rle_hbm(
         ),
         interpret=interpret,
     )
-    jitted = jax.jit(lambda a, b, c, d: call(a, b, c, d))
+    jitted = jax.jit(lambda a, b, c, d, e: call(a, b, c, d, e))
 
     def run():
         ol, orr, ordp, lenp, blk, rows, meta, err = jitted(*staged)

@@ -42,7 +42,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..common import ROOT_ORDER
-from .batch import KIND_LOCAL, OpTensors, prefill_logs
+from .batch import KIND_LOCAL, OpTensors, prefill_logs, require_unfused
 from .blocked import _require
 from .span_arrays import FlatDoc, I32, U32, make_flat_doc
 
@@ -390,6 +390,7 @@ def make_replayer_lanes(
     _require(bool((kinds == KIND_LOCAL).all()),
              "rle_lanes replays local streams; per-lane remote "
              "streams -> ops.rle_lanes_mixed")
+    require_unfused(ops, "the lanes engines")
     S, B = kinds.shape
     _require(capacity >= 8, "capacity must hold a few runs")
     s_pad = max(((S + chunk - 1) // chunk) * chunk, chunk)
@@ -844,6 +845,7 @@ def make_replayer_lanes_blocked(
     _require(bool((kinds == KIND_LOCAL).all()),
              "rle_lanes replays local streams; per-lane remote "
              "streams -> ops.rle_lanes_mixed")
+    require_unfused(ops, "the lanes engines")
     S, B = kinds.shape
     _require(block_k >= 8, "block_k must hold a few runs")
     _require(capacity % block_k == 0,

@@ -66,6 +66,7 @@ from .batch import (
     KIND_REMOTE_INS,
     OpTensors,
     _prefill_scatter,
+    require_unfused,
 )
 from .blocked import _require
 from .rle_lanes import (
@@ -641,6 +642,7 @@ def make_replayer_lanes_mixed(
     kinds = np.asarray(ops.kind)
     _require(kinds.ndim == 2, "rle_lanes_mixed takes stacked per-doc "
              "streams ([S, B] columns; see batch.stack_ops)")
+    require_unfused(ops, "the lanes engines")
     S, B = kinds.shape
     _require(capacity >= 8, "capacity must hold a few runs")
     s_pad = max(((S + chunk - 1) // chunk) * chunk, chunk)
@@ -1611,6 +1613,7 @@ def make_replayer_lanes_mixed_blocked(
     kinds = np.asarray(ops.kind)
     _require(kinds.ndim == 2, "rle_lanes_mixed takes stacked per-doc "
              "streams ([S, B] columns; see batch.stack_ops)")
+    require_unfused(ops, "the lanes engines")
     S, B = kinds.shape
     _require(block_k >= 8, "block_k must hold a few runs")
     _require(capacity % block_k == 0,

@@ -61,6 +61,7 @@ from .batch import (
     KIND_REMOTE_INS,
     OpTensors,
     prefill_logs,
+    require_unfused,
 )
 from .blocked import _cumsum_rows, _lane_scalar, _require, _shift_rows
 from .rle import (
@@ -928,6 +929,7 @@ def make_replayer_rle_mixed(
     """
     kinds = np.asarray(ops.kind)
     _require(kinds.ndim == 1, "rle-mixed engine takes one shared stream")
+    require_unfused(ops, "the rle-mixed engine")
     _require(capacity % block_k == 0,
              f"capacity ({capacity}) must be a multiple of block_k "
              f"({block_k})")

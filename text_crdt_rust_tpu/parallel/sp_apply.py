@@ -67,6 +67,7 @@ from ..ops.batch import (
     KIND_REMOTE_DEL,
     KIND_REMOTE_INS,
     OpTensors,
+    require_unfused,
 )
 
 ROOT_I = np.int32(np.uint32(ROOT_ORDER))  # -1
@@ -579,6 +580,7 @@ class SpDoc:
         clean stream, so the retry replays from the pre-stream state)."""
         kinds = np.asarray(ops.kind)
         assert kinds.ndim == 1, "sp apply takes one unbatched stream"
+        require_unfused(ops, "sp apply")
         # Local-only streams may run past the table range (local ops
         # never READ the tables, so SpDoc's local capability stays
         # unbounded); remote ops probe by order, so their order space
