@@ -360,6 +360,13 @@ def make_row(config, engine, n_ops, batch, wall, steps, hbm_bytes,
         # named as such (r2 verdict weak #5 fix).
         "mean_step_latency_us": round(wall / steps * 1e6, 3),
         "device_steps": int(steps),
+        # Fused-step accounting (ISSUE 6), present in EVERY row:
+        # ``steps_total`` = device steps actually run (post-fusion),
+        # ``steps_fused`` = op rows folded into earlier steps (0 on
+        # unfused configs).  Configs that fuse pass the real counts
+        # (and the per-shape histogram) via **extra, overriding these.
+        "steps_total": int(steps),
+        "steps_fused": 0,
         "hbm_bytes_accounted": int(hbm_bytes),
         "hbm_bytes_measured": measured,
         "ops": int(n_ops),
@@ -507,12 +514,22 @@ def cfg_northstar(args):
     want = data.end_content if not args.patches else expected_content(patches)
     assert base_str == want
 
+    fstats = None
     if args.engine in ("rle", "rle-hbm"):
         from text_crdt_rust_tpu.ops import rle_hbm as RH
 
         merged = B.merge_patches(patches)
         lmax = max([len(p.ins_content) for p in merged] + [1])
         ops, _ = B.compile_local_patches(merged, lmax=lmax, dmax=None)
+        # Generalized step fusion (ISSUE 6): fold the shapes the host
+        # coalescer cannot reach — replace pairs (delete+insert at one
+        # position land as ONE dual-branch step) and backwards insert
+        # bursts (W-row fused splices) — on the fused-splice engines.
+        # --fuse-w 1 disables; default 8 honors every K's headroom.
+        from text_crdt_rust_tpu.config import supports_fused_steps
+        fuse_w = args.fuse_w or 8
+        if fuse_w > 1 and supports_fused_steps(args.engine):
+            ops, fstats = B.fuse_steps(ops, fuse_w=fuse_w)
         # K=128 x 512 lanes x capacity 20,992 is the measured optimum
         # (r5 sweep, committed as perf/sweep_r4.json — written by
         # perf/sweep_r4.py: 3.80G ops/s vs 2.63G at the old 256x32768);
@@ -574,9 +591,15 @@ def cfg_northstar(args):
     groups = getattr(args, "groups", 1) if args.engine.startswith("rle") \
         else 1
     steps = ops.num_steps * max(groups, 1)
+    fuse_extra = {}
+    if fstats is not None:
+        fuse_extra = {"steps_fused": fstats.rows_saved * max(groups, 1),
+                      "steps_prefuse": fstats.steps_in * max(groups, 1),
+                      "fuse_shapes": dict(fstats.fused),
+                      "fuse_w": args.fuse_w or 8}
     return make_row("northstar_automerge_paper_full", args.engine, n_ops,
                     batch * max(groups, 1), wall, steps, hbm, base_ops, ok,
-                    reps=args.reps, **dist)
+                    reps=args.reps, **fuse_extra, **dist)
 
 
 def cfg_1_cpu(args):
@@ -1216,6 +1239,9 @@ def cfg_serve(args):
         p99_admission_to_applied_us=report["latency_us"]["p99"],
         tick_p50_ms=report["tick_ms"]["p50"],
         tick_p99_ms=report["tick_ms"]["p99"],
+        steps_fused=report["tick_ms"].get("fused_rows_saved", 0),
+        steps_prefuse=report["tick_ms"].get("steps_prefuse", 0),
+        ops_per_step=report["tick_ms"].get("ops_per_step", 1.0),
         fault_rate=0.10, zipf_alpha=1.1,
         note="closed-loop serving: ops/s counts applied CRDT item-ops "
              "end-to-end through admission/causal-buffer/batch ticks, "
@@ -1267,6 +1293,9 @@ def cfg_serve_lanes(args):
         splits=out["splits"], hint_misses=out["hint_misses"],
         tick_p50_ms=rep["tick_ms"]["p50"],
         tick_p99_ms=rep["tick_ms"]["p99"],
+        steps_fused=rep["tick_ms"].get("fused_rows_saved", 0),
+        steps_prefuse=rep["tick_ms"].get("steps_prefuse", 0),
+        ops_per_step=rep["tick_ms"].get("ops_per_step", 1.0),
         p50_admission_to_applied_us=rep["latency_us"]["p50"],
         p99_admission_to_applied_us=rep["latency_us"]["p99"],
         evictions=rep["evictions"], restores=rep["restores"],
@@ -1394,7 +1423,11 @@ def cfg_kevin(args):
                        wall, ops.num_steps,
                        2 * capacity * batchk * 4,
                        cpu_ops, got_len == n_tpu and order_ok,
-                       fuse_w=fuse_w, **dist)
+                       fuse_w=fuse_w,
+                       steps_fused=n_tpu - ops.num_steps,
+                       steps_prefuse=n_tpu,
+                       fuse_shapes={"burst": n_tpu - ops.num_steps},
+                       **dist)
     return [cpu_row, tpu_row]
 
 
@@ -1425,9 +1458,10 @@ def main() -> None:
                     help="kevin TPU prepend count (default = the full "
                          "reference workload, benches/yjs.rs:51-62)")
     ap.add_argument("--fuse-w", type=int, default=0,
-                    help="split-batch prepare width for kevin "
-                         "(BatchConfig.fuse_w; 0 = per-config default "
-                         "64 full / 8 smoke, 1 = unfused)")
+                    help="fused burst width: kevin's split-batch "
+                         "prepare (0 = default 64 full / 8 smoke) and "
+                         "northstar's generalized fuse_steps pass "
+                         "(0 = default 8); 1 = unfused everywhere")
     ap.add_argument("--merge-rows", action="store_true",
                     help="with a single --config: merge the produced "
                          "rows into --out (replacing that cfg_key's "

@@ -205,11 +205,13 @@ class BlockedLaneSim:
             before += rw
         return len(self.order) - 1, before - rw
 
-    def _maybe_split(self, li):
+    def _maybe_split(self, li, w=1):
         """Returns True when a split fired (the kernel re-descends
-        under ``lax.cond`` only then)."""
+        under ``lax.cond`` only then).  ``w`` > 1 is a fused W-row
+        splice needing W + 1 rows of headroom (the kernel's
+        ``r0 + w + 1 > K`` check)."""
         b = self.order[li]
-        if len(self.blocks[b]) + 2 <= self.K:
+        if len(self.blocks[b]) + w + 1 <= self.K:
             return False
         assert len(self.blocks) < self.cap // self.K, "out of blocks"
         rows = self.blocks[b]
@@ -238,16 +240,22 @@ class BlockedLaneSim:
 
     # -- ops --------------------------------------------------------------
 
-    def insert_local(self, pos, il, st):
+    def insert_local(self, pos, il, st, w=1):
+        """``w`` > 1 is a FUSED backwards-burst step: W stride-L runs
+        (descending orders in doc order) land in ONE splice — same
+        one-block cost, W + 1 rows of split headroom, merge w==1-only
+        (the kernels' contract)."""
         li, before = self._slot_of_live(pos) if pos else (0, 0)
-        if self._maybe_split(li):
+        if self._maybe_split(li, w):
             li, before = self._slot_of_live(pos) if pos else (0, 0)
         b = self.order[li]
         self._block_cost(b)
         rows = self.blocks[b]
         local = pos - before
+        L = il // w
+        new = [[st + il - (j + 1) * L, L, True] for j in range(w)]
         if pos == 0:
-            rows.insert(0, [st, il, True])
+            rows[0:0] = new
         else:
             at = 0
             for i, r in enumerate(rows):
@@ -256,14 +264,15 @@ class BlockedLaneSim:
                     off_live = local - at
                     # char offset of the off_live-th live char's end
                     off = off_live
-                    if r[2] and off == r[1] and st == r[0] + r[1]:
+                    if (w == 1 and r[2] and off == r[1]
+                            and st == r[0] + r[1]):
                         r[1] += il
                     elif off == r[1]:
-                        rows.insert(i + 1, [st, il, True])
+                        rows[i + 1: i + 1] = new
                     elif off < r[1]:
                         tail = [r[0] + off, r[1] - off, r[2]]
                         rows[i: i + 1] = [[r[0], off, r[2]],
-                                          [st, il, True], tail]
+                                          *new, tail]
                     break
                 at += lv
         for o in range(st, st + il):
@@ -520,6 +529,7 @@ def _replay_stream(sim: BlockedLaneSim, unb: UnblockedCost, c: Counter,
     olp = np.asarray(ops.origin_left).astype(np.int64)
     iln = np.asarray(ops.ins_len)
     stt = np.asarray(ops.ins_order_start)
+    wcol = np.maximum(np.asarray(ops.rows_per_step), 1)
     for s in range(ops.num_steps):
         k, p, dl, il = (int(kind[s]), int(pos[s]), int(dln[s]),
                         int(iln[s]))
@@ -531,7 +541,9 @@ def _replay_stream(sim: BlockedLaneSim, unb: UnblockedCost, c: Counter,
         if k == 0 and il:
             c.steps += 1
             unb.local_insert(c)
-            sim.begin_step(); sim.insert_local(p, il, st); sim.end_step()
+            sim.begin_step()
+            sim.insert_local(p, il, st, int(wcol[s]))
+            sim.end_step()
         if k == 1 and il:
             c.steps += 1
             unb.remote_insert(c, sim.ocap)

@@ -1,0 +1,59 @@
+#!/bin/bash
+# Round-9 recovery watcher (ISSUE 6 / ROADMAP #4): generalized fused
+# multi-row steps are CPU-proven (perf/fused_traces_r9.json: automerge
+# 35.0x / rustcode 3.5x / sveltecomponent 4.2x event-step cut, all four
+# fused-splice surfaces bit-identical) — this arms the silicon
+# re-record.  Supersedes when_up_r8.sh and keeps its gate chain:
+# matmul tunnel probe -> compile pin -> fused kevin device smoke (the
+# W-row splice + rows_per_step SMEM column on real Mosaic) -> kevin
+# full 5M -> the remaining rows, now with the fused defaults live:
+# northstar records the fuse_steps'd merged stream (--fuse-w 8 default,
+# steps_fused/fuse_shapes in the row payload) and serve/serve-lanes
+# record fused ticks end-to-end (tick_summary fused-step counters).
+# Each config re-records through `--merge-rows` (single config ->
+# BENCH_ALL.json row replacement; no hand-editing, no suite resume).
+# Safe to re-run; appends to perf/when_up_r9.log.
+set -u
+cd /root/repo
+while true; do
+  if timeout 240 python -c "
+import jax, numpy as np, jax.numpy as jnp
+x = jnp.ones((128,128), jnp.bfloat16)
+assert float(np.asarray(x @ x)[0,0]) == 128.0
+" >/dev/null 2>&1; then
+    echo "$(date -u +%H:%M:%S) tunnel is back (r9 watcher)" >> perf/when_up_r9.log
+    break
+  fi
+  echo "$(date -u +%H:%M:%S) still down (r9)" >> perf/when_up_r9.log
+  sleep 120
+done
+timeout 2400 python perf/compile_pin.py >> perf/compile_pin_r9.log 2>&1 \
+  || echo "PIN FAILED/TIMED OUT rc=$? - investigate before trusting bench" \
+       >> perf/compile_pin_r9.log
+# Fused-kernel device smoke first: a tiny fused kevin (2048 prepends,
+# W=8) proves the W-row splice compiles on real Mosaic before
+# committing to the 40-min full run.
+timeout 1800 python bench.py --config kevin --smoke --no-probe \
+  >> perf/when_up_r9.log 2>&1 \
+  || { echo "fused kevin device smoke FAILED rc=$? - NOT re-recording" \
+         >> perf/when_up_r9.log; exit 1; }
+# Second gate: a fused serve-lanes loadgen smoke — the blocked mixed
+# kernel's fused splice + the serve stack's fused ticks on device.
+timeout 1800 python -m text_crdt_rust_tpu.serve.loadgen --device \
+  --docs 24 --ticks 10 --engine rle-lanes-mixed \
+  >> perf/when_up_r9.log 2>&1 \
+  || { echo "fused serve-lanes device smoke FAILED rc=$? - NOT re-recording" \
+         >> perf/when_up_r9.log; exit 1; }
+# Headline: kevin at full 5M, fused W=64 (rle-hbm-fused row).
+timeout 7200 python bench.py --config kevin --merge-rows --no-probe \
+  >> perf/bench_kevin_r9.log 2>&1 \
+  || echo "kevin re-record FAILED rc=$?" >> perf/when_up_r9.log
+# Remaining rows, most verdict-critical first; northstar + serve rows
+# pick up the fused defaults (steps_fused / fuse_shapes / tick_summary
+# counters land in the payloads automatically).
+for cfg in northstar 4 5r 5 serve serve-lanes sp; do
+  timeout 7200 python bench.py --config "$cfg" --merge-rows --no-probe \
+    >> "perf/bench_cfg${cfg}_r9.log" 2>&1 \
+    || echo "config $cfg re-record FAILED rc=$?" >> perf/when_up_r9.log
+done
+echo "$(date -u +%H:%M:%S) r9 re-record done" >> perf/when_up_r9.log

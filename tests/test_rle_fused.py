@@ -129,26 +129,38 @@ class TestFusedCompile:
         assert B.row_growth_bound_ops(ops_u) == B.row_growth_bound(8)
 
     def test_unfused_engines_reject_fused_streams(self):
-        from text_crdt_rust_tpu.ops import rle_lanes as RL
         patches = [TestPatch(0, 0, "x")] * 4
         ops, _ = B.compile_local_patches(patches, lmax=4, fuse_w=4)
         with pytest.raises(ValueError, match="fused"):
             F.apply_ops(SA.make_flat_doc(64), ops)
-        with pytest.raises(ValueError, match="fused"):
-            RL.replay_lanes(B.stack_ops([ops]), capacity=64,
-                            interpret=True)
         # ...and the fused engines bound W by the one-split headroom.
         with pytest.raises(ValueError, match="headroom"):
             R.replay_local_rle(ops, capacity=64, batch=8, block_k=8,
                                chunk=32, interpret=True)
+
+    def test_reject_message_derives_from_registry(self):
+        # The reject error names the CURRENT fused engines from the ONE
+        # registry — no hard-coded module list to rot (ISSUE 6).
+        from text_crdt_rust_tpu.config import ENGINE_REGISTRY
+        patches = [TestPatch(0, 0, "x")] * 4
+        ops, _ = B.compile_local_patches(patches, lmax=4, fuse_w=4)
+        fused = tuple(n for n, s in ENGINE_REGISTRY.items()
+                      if s.get("fused_steps"))
+        assert B.fused_engine_names() == fused
+        with pytest.raises(ValueError) as ei:
+            B.require_unfused(ops, "flat")
+        for name in fused:
+            assert name in str(ei.value)
 
     def test_registry_fused_flag(self):
         from text_crdt_rust_tpu.config import supports_fused_steps
         assert supports_fused_steps("rle")
         assert supports_fused_steps("rle-hbm")
         assert supports_fused_steps("rle-hbm-fused")  # row alias
+        # ISSUE 6: the lanes engines grew the W-row splice.
+        assert supports_fused_steps("rle-lanes")
+        assert supports_fused_steps("rle-lanes-mixed")
         assert not supports_fused_steps("flat")
-        assert not supports_fused_steps("rle-lanes-mixed")
         assert not supports_fused_steps("native-cpp")
 
 
@@ -204,6 +216,244 @@ class TestFusedKernels:
             ref = F.apply_ops(SA.make_flat_doc(1024), ops_u)
             assert SA.doc_spans(df) == SA.doc_spans(ref), seed
 
+def _event_pair(patches, ranks=None, fuse_w=1, lmax=8):
+    """Compile each patch as its OWN step stream (the serve-batcher
+    shape: per-event compilation, the host coalescer never runs), then
+    concat + one ``fuse_steps`` pass.  Returns (unfused, fused, stats).
+    """
+    streams, no = [], 0
+    for p, rk in zip(patches, ranks or [0] * len(patches)):
+        ops, no = B.compile_local_patches(
+            [p], rank=rk, lmax=lmax, start_order=no)
+        streams.append(ops)
+    ops_u = B.concat_ops(streams)
+    fused, st = B.fuse_steps(ops_u, fuse_w=fuse_w)
+    return ops_u, fused, st
+
+
+def _flat_pair_equal(ops_u, ops_f, capacity=256):
+    """Both streams through the flat oracle; full doc state bit-equal.
+    (W = 1 fused streams only — flat rejects multi-row steps.)"""
+    du = F.apply_ops(SA.make_flat_doc(capacity), ops_u)
+    df = F.apply_ops(SA.make_flat_doc(capacity), ops_f)
+    for f in DOC_FIELDS:
+        assert np.array_equal(np.asarray(getattr(du, f)),
+                              np.asarray(getattr(df, f))), f
+    return df
+
+
+class TestFuseSteps:
+    """The GENERALIZED step fuser (ISSUE 6): per-shape fusion rules +
+    rejection fallbacks, host-level vs the flat oracle."""
+
+    def test_typing_run_fuses_to_one_step(self):
+        patches = [TestPatch(0, 0, "he"), TestPatch(2, 0, "ll"),
+                   TestPatch(4, 0, "o")]
+        ops_u, fused, st = _event_pair(patches)
+        assert fused.num_steps == 1 and st.fused["typing"] == 2
+        df = _flat_pair_equal(ops_u, fused)
+        assert SA.to_string(df) == "hello"
+
+    def test_backspace_and_forward_sweeps(self):
+        typing = [TestPatch(i, 0, "a") for i in range(8)]
+        back = [TestPatch(7 - i, 1, "") for i in range(4)]   # backspace
+        fwd = [TestPatch(0, 1, "") for _ in range(3)]        # fwd delete
+        ops_u, fused, st = _event_pair(typing + back + fwd)
+        # typing -> 1, backspace sweep -> 1, forward sweep -> 1.
+        assert fused.num_steps == 3
+        assert st.fused["sweep"] == 5 and st.fused["typing"] == 7
+        df = _flat_pair_equal(ops_u, fused)
+        assert SA.to_string(df) == "a"
+
+    def test_cross_agent_sweep_fuses(self):
+        # Deletes log no rank -> different authors' contiguous deletes
+        # fuse into one step.
+        typing = [TestPatch(0, 0, "abcdef")]
+        dels = [TestPatch(2, 1, ""), TestPatch(2, 1, "")]
+        ops_u, fused, st = _event_pair(typing + dels, ranks=[0, 1, 2])
+        assert st.fused["sweep"] == 1
+        df = _flat_pair_equal(ops_u, fused)
+        assert SA.to_string(df) == "abef"
+
+    def test_replace_pair_fuses_cross_agent(self):
+        # A pure delete + pure insert at the same position -> the ONE
+        # dual-branch KIND_LOCAL row a compiled replace already is;
+        # the delete's author logs nothing, so authors may differ.
+        patches = [TestPatch(0, 0, "abcd"), TestPatch(1, 2, ""),
+                   TestPatch(1, 0, "XY")]
+        ops_u, fused, st = _event_pair(patches, ranks=[0, 1, 0])
+        assert st.fused["replace"] == 1 and fused.num_steps == 2
+        df = _flat_pair_equal(ops_u, fused)
+        assert SA.to_string(df) == "aXYd"
+        # The fused row fires BOTH branches in one step.
+        both = (np.asarray(fused.del_len) > 0) \
+            & (np.asarray(fused.ins_len) > 0)
+        assert both.sum() == 1
+
+    def test_cross_agent_insert_does_not_fuse(self):
+        # Insert-bearing fusion merges rank attribution -> requires
+        # equal ranks; a differing author falls back to its own step.
+        patches = [TestPatch(0, 0, "ab"), TestPatch(2, 0, "cd")]
+        ops_u, fused, st = _event_pair(patches, ranks=[0, 1])
+        assert fused.num_steps == 2 and st.rows_saved == 0
+        _flat_pair_equal(ops_u, fused)
+
+    def test_overlap_rejection_falls_back(self):
+        # An op whose position lands INSIDE the previous op's span (not
+        # chaining at its tail) can never satisfy the contiguity rules
+        # -> no fusion, byte-identical passthrough.
+        patches = [TestPatch(0, 0, "abcd"), TestPatch(2, 0, "xy")]
+        ops_u, fused, st = _event_pair(patches)
+        assert st.rows_saved == 0
+        for name in ops_u.__dataclass_fields__:
+            assert np.array_equal(np.asarray(getattr(ops_u, name)),
+                                  np.asarray(getattr(fused, name))), name
+
+    def test_burst_detection_in_fuser_matches_compiler(self):
+        # The fuser's backwards-burst rule reproduces the patch-level
+        # kevin detector: same rows_per_step layout, same tensors.
+        patches = [TestPatch(0, 0, "k")] * 6
+        ops_c, _ = B.compile_local_patches(patches, lmax=6, fuse_w=6)
+        ops_u, fused, st = _event_pair(patches, fuse_w=6, lmax=6)
+        assert st.fused["burst"] == 5
+        for name in ops_c.__dataclass_fields__:
+            assert np.array_equal(np.asarray(getattr(ops_c, name)),
+                                  np.asarray(getattr(fused, name))), name
+
+    def test_remote_runs_fuse(self):
+        # Chunked remote insert runs chain across steps (origin_left =
+        # previous tail, shared origin_right, continued orders) and
+        # contiguous remote delete targets sweep — both fuse; the
+        # result replays bit-identically on the flat engine.
+        from text_crdt_rust_tpu.common import (
+            RemoteDel, RemoteId, RemoteIns, RemoteTxn)
+        ROOT = RemoteId("ROOT", 0xFFFFFFFF)
+        table = B.AgentTable(["p"])
+        # txn 2 continues txn 1's run (origin_left = its tail, shared
+        # origin_right, contiguous orders) — the typing-continuation
+        # shape, fused ACROSS txns; then two order-contiguous deletes.
+        txns = [
+            RemoteTxn(RemoteId("p", 0), [ROOT], [
+                RemoteIns(ROOT, ROOT, "abcd")]),
+            RemoteTxn(RemoteId("p", 4), [RemoteId("p", 3)], [
+                RemoteIns(RemoteId("p", 3), ROOT, "efgh")]),
+            RemoteTxn(RemoteId("p", 8), [RemoteId("p", 7)], [
+                RemoteDel(RemoteId("p", 1), 2),
+                RemoteDel(RemoteId("p", 3), 2)]),
+        ]
+        ops_u, _ = B.compile_remote_txns(txns, table, lmax=8)
+        fused, st = B.fuse_steps(ops_u)
+        assert st.fused["remote_ins_run"] == 1
+        assert st.fused["remote_del_run"] == 1
+        _flat_pair_equal(ops_u, fused)
+
+    def test_remote_runs_fuse_on_mixed_lanes(self):
+        # The serve path applies fused remote rows via the MIXED lanes
+        # kernels: the fused run's single YATA cursor walk and by-order
+        # tables must match the unfused per-chunk steps ON THE KERNELS,
+        # not just the flat oracle.  Lane 0 carries the unfused stream,
+        # lane 1 the fused one, at tests/test_fuzz_blocked.py's fixed
+        # geometry so tier-1 pays no extra kernel builds.
+        from text_crdt_rust_tpu.common import (
+            RemoteDel, RemoteId, RemoteIns, RemoteTxn)
+        from text_crdt_rust_tpu.ops import rle_lanes as RL
+        from text_crdt_rust_tpu.ops import rle_lanes_mixed as RLM
+        ROOT = RemoteId("ROOT", 0xFFFFFFFF)
+        table = B.AgentTable(["q", "p"])
+        txns = [
+            # A concurrent rival first: the fused 8-char run's
+            # integrate cursor must scan/tiebreak past it exactly as
+            # the two chunked 4-char steps would.
+            RemoteTxn(RemoteId("q", 0), [ROOT], [
+                RemoteIns(ROOT, ROOT, "QQ")]),
+            RemoteTxn(RemoteId("p", 0), [ROOT], [
+                RemoteIns(ROOT, ROOT, "abcd")]),
+            RemoteTxn(RemoteId("p", 4), [RemoteId("p", 3)], [
+                RemoteIns(RemoteId("p", 3), ROOT, "efgh")]),
+            RemoteTxn(RemoteId("p", 8), [RemoteId("p", 7)], [
+                RemoteDel(RemoteId("p", 1), 2),
+                RemoteDel(RemoteId("p", 3), 2)]),
+        ]
+        ops_u, _ = B.compile_remote_txns(txns, table, lmax=8)
+        fused, st = B.fuse_steps(ops_u)
+        assert st.fused["remote_ins_run"] == 1
+        assert st.fused["remote_del_run"] == 1
+        stacked = B.stack_ops([B.pad_ops(ops_u, 64),
+                               B.pad_ops(fused, 64)])
+        kw = dict(capacity=128, order_capacity=256, chunk=32,
+                  interpret=True)
+        flat = RLM.replay_lanes_mixed(stacked, **kw)
+        blk = RLM.replay_lanes_mixed_blocked(stacked, block_k=16, **kw)
+        for res in (flat, blk):
+            res.check()
+            assert (RL.expand_lane(res, 0).tolist()
+                    == RL.expand_lane(res, 1).tolist())
+            for tab in ("oll", "orl"):
+                t = np.asarray(getattr(res, tab))
+                assert np.array_equal(t[:, 0], t[:, 1]), tab
+
+    def test_fuser_respects_lmax(self):
+        patches = [TestPatch(0, 0, "abc"), TestPatch(3, 0, "def")]
+        ops_u, fused, st = _event_pair(patches, lmax=4)
+        assert st.rows_saved == 0  # 3 + 3 > lmax 4: no merge
+
+    def test_fuser_respects_dmax(self):
+        # A stream chunked at compile-time dmax must not have its
+        # delete runs re-merged past it (engines with a hard per-step
+        # target cap reject wider runs).
+        typing = [TestPatch(0, 0, "abcdefgh")]
+        dels = [TestPatch(0, 2, ""), TestPatch(0, 2, ""),
+                TestPatch(0, 2, "")]
+        ops_u, no = B.compile_local_patches(typing + dels, lmax=8,
+                                            dmax=2)
+        fused, st = B.fuse_steps(ops_u, dmax=2)
+        assert st.fused["sweep"] == 0  # 2 + 2 > dmax 2: no merge
+        unbounded, st2 = B.fuse_steps(ops_u)
+        assert st2.fused["sweep"] == 2  # no cap: one 6-target sweep
+        for f in (fused, unbounded):
+            df = _flat_pair_equal(ops_u, f, capacity=64)
+            assert SA.to_string(df) == "gh"
+
+    def test_compile_local_patches_fuse_shapes_all(self):
+        # The fuse_shapes="all" hook == compile then fuse_steps.
+        patches = [TestPatch(0, 0, "ab"), TestPatch(2, 0, "cd"),
+                   TestPatch(0, 4, "")]
+        ops_a, no_a = B.compile_local_patches(
+            patches, lmax=8, fuse_shapes="all")
+        ops_u, no_u = B.compile_local_patches(patches, lmax=8)
+        fused, _ = B.fuse_steps(ops_u)
+        assert no_a == no_u
+        for name in ops_a.__dataclass_fields__:
+            assert np.array_equal(np.asarray(getattr(ops_a, name)),
+                                  np.asarray(getattr(fused, name))), name
+
+
+class TestFusedKernelsGeneralized:
+    def test_event_stream_shapes_bit_identity(self):
+        # Mixed typing/sweep/replace/burst EVENT streams (one compiled
+        # step per patch) fused at FW through the VMEM kernel at the
+        # file's one fixed geometry, vs unfused + the flat oracle.
+        rng = random.Random(11)
+        patches, content = burst_patches(rng, 56)
+        streams, no = [], 0
+        for p in patches:
+            ops, no = B.compile_local_patches([p], lmax=16,
+                                              start_order=no)
+            streams.append(ops)
+        ops_u = B.concat_ops(streams)
+        fused, st = B.fuse_steps(ops_u, fuse_w=FW)
+        assert st.rows_saved > 0 and st.fused["burst"] > 0
+        assert ops_u.num_steps <= SMAX and fused.num_steps <= SMAX
+        ops_u = B.pad_ops(ops_u, SMAX)
+        ops_f = B.pad_ops(fused, SMAX)
+        res_u = R.replay_local_rle(ops_u, **GEOM)
+        res_f = R.replay_local_rle(ops_f, **GEOM)
+        du, df = _assert_equivalent(ops_u, ops_f, res_u, res_f,
+                                    content=content)
+        ref = F.apply_ops(SA.make_flat_doc(1024), ops_u)
+        assert SA.doc_spans(df) == SA.doc_spans(ref)
+
+
 @pytest.mark.slow
 class TestFusedDeep:
     def test_fuzz_hbm_ride_along(self):
@@ -230,6 +480,86 @@ class TestFusedDeep:
                                         content=content)
             ref = F.apply_ops(SA.make_flat_doc(1024), ops_u)
             assert SA.doc_spans(df) == SA.doc_spans(ref), seed
+
+    def test_fuzz_event_streams_deep(self):
+        # Generalized-shape deep fuzz: event-granularity streams fused
+        # by fuse_steps (typing/sweep/replace/burst mixes) vs unfused +
+        # the flat oracle on the VMEM kernel.
+        for seed in range(40, 70):
+            rng = random.Random(seed)
+            patches, content = burst_patches(rng, 56)
+            streams, no = [], 0
+            for p in patches:
+                ops, no = B.compile_local_patches([p], lmax=16,
+                                                  start_order=no)
+                streams.append(ops)
+            ops_u = B.concat_ops(streams)
+            fused, _ = B.fuse_steps(ops_u, fuse_w=FW)
+            ops_u = B.pad_ops(ops_u, SMAX)
+            ops_f = B.pad_ops(fused, SMAX)
+            res_u = R.replay_local_rle(ops_u, **GEOM)
+            res_f = R.replay_local_rle(ops_f, **GEOM)
+            du, df = _assert_equivalent(ops_u, ops_f, res_u, res_f,
+                                        content=content)
+            ref = F.apply_ops(SA.make_flat_doc(1024), ops_u)
+            assert SA.doc_spans(df) == SA.doc_spans(ref), seed
+
+    def test_fuzz_lanes_engines_fused(self):
+        # The lanes engines' new W-row splice: fused-vs-unfused per-lane
+        # expansion + (mixed) by-order tables, blocked and un-blocked.
+        from text_crdt_rust_tpu.ops import rle_lanes as RL
+        from text_crdt_rust_tpu.ops import rle_lanes_mixed as RLM
+        for seed in range(6):
+            rng = random.Random(200 + seed)
+            pair = [burst_patches(rng, 48) for _ in range(2)]
+            ops_u = B.stack_ops([
+                B.pad_ops(B.compile_local_patches(p, lmax=16)[0], SMAX)
+                for p, _ in pair])
+            ops_f = B.stack_ops([
+                B.pad_ops(B.compile_local_patches(
+                    p, lmax=16, fuse_w=FW)[0], SMAX)
+                for p, _ in pair])
+            lkw = dict(capacity=CAPF, chunk=64, interpret=True)
+            ru = RL.replay_lanes(ops_u, **lkw)
+            rf = RL.replay_lanes(ops_f, **lkw)
+            for b in range(2):
+                assert np.array_equal(
+                    RL.expand_lane(ru, b), RL.expand_lane(rf, b)), seed
+            bu = RL.make_replayer_lanes_blocked(
+                ops_u, block_k=KF, **lkw)()
+            bf = RL.make_replayer_lanes_blocked(
+                ops_f, block_k=KF, **lkw)()
+            bu.check()
+            bf.check()
+            for b in range(2):
+                assert np.array_equal(RL.expand_lane_blocked(bu, b),
+                                      RL.expand_lane_blocked(bf, b)), seed
+            mu = RLM.replay_lanes_mixed(ops_u, **lkw)
+            mf = RLM.replay_lanes_mixed(ops_f, **lkw)
+            assert np.array_equal(np.asarray(mu.oll),
+                                  np.asarray(mf.oll)), seed
+            assert np.array_equal(np.asarray(mu.orl),
+                                  np.asarray(mf.orl)), seed
+            xu = RLM.replay_lanes_mixed_blocked(ops_u, block_k=KF, **lkw)
+            xf = RLM.replay_lanes_mixed_blocked(ops_f, block_k=KF, **lkw)
+            xu.check()
+            xf.check()
+            assert np.array_equal(np.asarray(xu.oll),
+                                  np.asarray(xf.oll)), seed
+            assert np.array_equal(np.asarray(xu.orl),
+                                  np.asarray(xf.orl)), seed
+
+    def test_trace_prefix_at_scale(self):
+        # A real-trace prefix (automerge-paper) at event granularity
+        # through the probe's identity path — the committed
+        # perf/fused_traces_r9.json shape, bigger than the tier-1 smoke.
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "fused_trace_probe", "perf/fused_trace_probe.py")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        row = mod.identity_prefix("automerge-paper", 600, fuse_w=8)
+        assert row["oracle_equal"], row
 
     def test_kevin_at_scale(self):
         # The acceptance shape: a long pure-prepend stream at the bench

@@ -167,3 +167,47 @@ def test_oracle_signed_encoding():
     doc.local_delete(a, 1, 1)
     want = np.asarray([1, -2, 3], dtype=np.int32)
     assert np.array_equal(oracle_signed(doc), want)
+
+
+def test_tick_stream_fusion_counters_and_identity():
+    """Generalized tick-stream fusion (ISSUE 6): a typing run + a
+    backspace sweep + a replace submitted as SEPARATE events in one
+    tick fuse into fewer device steps (per-event compilation would pay
+    one step each), the lane stays bit-identical to the oracle, and
+    ``tick_summary`` exports the fused-step counters."""
+    srv = DocServer(cfg(fuse_steps=True, fuse_w=4))
+    srv.admit_doc("d")
+    for i in range(4):                       # typing run: h-e-l-o
+        srv.submit_local("d", "ed", i, ins_content="helo"[i])
+    srv.tick()
+    for i in range(3):                       # backspace sweep
+        srv.submit_local("d", "ed", 3 - i, del_len=1)
+    srv.tick()
+    srv.submit_local("d", "ed", 0, del_len=1)      # replace pair
+    srv.submit_local("d", "ed", 0, ins_content="X")
+    srv.tick()
+    assert srv.doc_string("d") == "X"
+    assert_lanes_equal_oracles(srv)
+    ts = srv.tick_summary()
+    assert ts["fused_rows_saved"] >= 3 + 2 + 1
+    assert ts["steps_total"] < ts["steps_prefuse"]
+    assert ts["ops_per_step"] > 1.0
+    fs = srv.batcher.fuse_stats.fused
+    assert fs["typing"] >= 3 and fs["sweep"] >= 2 and fs["replace"] >= 1
+
+
+def test_fusion_off_is_per_event_steps():
+    """fuse_steps=False keeps one compiled step per event (the pre-
+    ISSUE-6 behavior) — and the final state is the same either way."""
+    out = {}
+    for fuse in (False, True):
+        srv = DocServer(cfg(fuse_steps=fuse))
+        srv.admit_doc("d")
+        for i in range(4):
+            srv.submit_local("d", "ed", i, ins_content="abcd"[i])
+        srv.tick()
+        assert_lanes_equal_oracles(srv)
+        out[fuse] = srv.doc_string("d")
+        saved = srv.tick_summary()["fused_rows_saved"]
+        assert (saved > 0) == fuse
+    assert out[False] == out[True] == "abcd"

@@ -30,13 +30,15 @@ ENGINE_REGISTRY = {
                         "fused_steps": True},
     "rle-hbm":         {"module": "ops.rle_hbm", "configs": ("northstar", "kevin"),
                         "fused_steps": True},
-    "rle-lanes":       {"module": "ops.rle_lanes", "configs": ("5",)},
+    "rle-lanes":       {"module": "ops.rle_lanes", "configs": ("5",),
+                        "fused_steps": True},
     "rle-mixed":       {"module": "ops.rle_mixed", "configs": ("4",)},
     # The blocked per-lane mixed engine serves two surfaces: the config
     # 5r streaming replay AND the document server's lane backend
     # (serve/lanes_backend.py carries the blocked state across ticks).
     "rle-lanes-mixed": {"module": "ops.rle_lanes_mixed",
                         "configs": ("5r", "serve", "serve-lanes"),
+                        "fused_steps": True,
                         "serve_backend":
                             "serve.lanes_backend:LanesMixedLaneBackend"},
     "blocked":         {"module": "ops.blocked", "configs": ("northstar",)},
@@ -207,6 +209,15 @@ class ServeConfig:
     rate_capacity: int = 0          # token bucket size per agent (0 = off)
     rate_refill: int = 0            # tokens added per tick per agent
     spool_dir: Optional[str] = None  # eviction checkpoint directory
+    fuse_steps: bool = True    # generalized tick-stream fusion
+    #                            (ops.batch.fuse_steps): typing runs /
+    #                            sweeps / replaces / remote runs always
+    #                            coalesce; W-row bursts additionally on
+    #                            fused_steps backends (ISSUE 6)
+    fuse_w: int = 8            # burst width cap; effective W is
+    #                            min(fuse_w, lanes_block_k // 2 - 1) on
+    #                            backends with the W-row splice, 1 on
+    #                            the rest (the one-split headroom rule)
 
     def add_args(self, ap: argparse.ArgumentParser) -> None:
         ap.add_argument("--serve-shards", type=int, default=self.num_shards)

@@ -247,14 +247,20 @@ class _Rows:
     def emit(self, *, kind=0, pos=0, del_len=0, del_target=0,
              origin_left=ROOT_ORDER, origin_right=ROOT_ORDER, ins_len=0,
              ins_order_start=0, order_advance=0, rank=0, rows=1,
-             content: str = "") -> None:
+             content="") -> None:
+        # ``content``: str, or a uint32 codepoint array (``fuse_steps``
+        # re-emits rows it already holds as codepoints — the serve tick
+        # hot path — without a utf-32 decode/encode round trip).
         assert ins_len <= self.lmax
         assert rows >= 1 and (rows == 1 or ins_len % rows == 0)
         cps = np.zeros(self.lmax, dtype=np.uint32)
-        if content:
+        if len(content):
             assert len(content) == ins_len
-            cps[:ins_len] = np.frombuffer(
-                content.encode("utf-32-le"), dtype=np.uint32)
+            if isinstance(content, str):
+                cps[:ins_len] = np.frombuffer(
+                    content.encode("utf-32-le"), dtype=np.uint32)
+            else:
+                cps[:ins_len] = content
         c = self.cols
         c["kind"].append(kind); c["pos"].append(pos)
         c["del_len"].append(del_len); c["del_target"].append(del_target)
@@ -340,15 +346,27 @@ def fused_width(ops: OpTensors) -> int:
     return max(int(r.max()) if r.size else 1, 1)
 
 
+def fused_engine_names() -> Tuple[str, ...]:
+    """Engines whose insert splice accepts W-row fused steps, from the
+    ONE registry (``config.ENGINE_REGISTRY`` ``fused_steps``) — error
+    messages and serve gating derive from this instead of hard-coded
+    module lists that rot as engines gain the splice."""
+    from ..config import ENGINE_REGISTRY
+
+    return tuple(n for n, spec in ENGINE_REGISTRY.items()
+                 if spec.get("fused_steps"))
+
+
 def require_unfused(ops: OpTensors, engine: str) -> None:
     """The ONE reject guard for engines without the W-row splice (every
-    engine except ops.rle / ops.rle_hbm calls this at build time — a
-    fused stream on an unfused engine would silently misapply, its row
-    columns read as one wide plain insert)."""
+    engine without a registry ``fused_steps`` flag calls this at build
+    time — a fused stream on an unfused engine would silently misapply,
+    its row columns read as one wide plain insert)."""
     if fused_width(ops) > 1:
         raise ValueError(
             f"{engine} has no fused multi-row splice; compile with "
-            f"fuse_w=1 (fused streams run on ops.rle / ops.rle_hbm)")
+            f"fuse_w=1 (fused streams run on the registry fused_steps "
+            f"engines: {', '.join(fused_engine_names())})")
 
 
 def fused_width_checked(streams, block_k: int) -> int:
@@ -393,6 +411,7 @@ def compile_local_patches(
     start_order: int = 0,
     dmax: Optional[int] = None,
     fuse_w: int = 1,
+    fuse_shapes: str = "burst",
 ) -> Tuple[OpTensors, int]:
     """Single-author local edit stream -> op tensors.
 
@@ -415,9 +434,15 @@ def compile_local_patches(
     splice would split that merged run at the exact same boundary the
     unfused stream does).  Only the fused engines (``ENGINE_REGISTRY``
     entries with ``fused_steps``) accept W > 1 streams.
+
+    ``fuse_shapes="all"`` additionally runs the GENERALIZED step fuser
+    (``fuse_steps``: typing runs, delete sweeps, replace pairs, remote
+    runs — ISSUE 6) over the compiled rows before returning; "burst"
+    keeps today's behavior (the patch-level kevin detector only).
     """
     assert dmax is None or dmax >= 1, f"dmax must be >= 1, got {dmax}"
     assert fuse_w >= 1, f"fuse_w must be >= 1, got {fuse_w}"
+    assert fuse_shapes in ("burst", "all"), fuse_shapes
     rows = _Rows(lmax)
     next_order = start_order
     patches = list(patches)
@@ -481,13 +506,321 @@ def compile_local_patches(
             )
             next_order += len(chunk)
             off += len(chunk)
-    return rows.to_tensors(), next_order
+    ops = rows.to_tensors()
+    if fuse_shapes == "all":
+        ops, _ = fuse_steps(ops, fuse_w=fuse_w, dmax=dmax)
+    return ops, next_order
 
 
 def compile_trace(data: TestData, rank: int = 0, lmax: int = 16
                   ) -> Tuple[OpTensors, int]:
     """Whole-trace convenience wrapper (the `benches/yjs.rs:32-49` replay)."""
     return compile_local_patches(flatten_patches(data), rank=rank, lmax=lmax)
+
+
+# -- generalized step fusion (ISSUE 6) ---------------------------------------
+
+# Fusable shapes, named for the histogram.  Each entry counts ROWS
+# ELIMINATED (ops that piggybacked on an earlier step's row).
+FUSE_SHAPES = ("typing", "sweep", "replace", "burst",
+               "remote_ins_run", "remote_del_run")
+
+
+@dataclasses.dataclass
+class FuseStats:
+    """Per-shape accounting of one ``fuse_steps`` pass."""
+
+    steps_in: int = 0
+    steps_out: int = 0
+    fused: Dict[str, int] = dataclasses.field(
+        default_factory=lambda: {s: 0 for s in FUSE_SHAPES})
+
+    @property
+    def rows_saved(self) -> int:
+        return self.steps_in - self.steps_out
+
+    @property
+    def reduction_x(self) -> float:
+        return self.steps_in / self.steps_out if self.steps_out else 1.0
+
+    def to_dict(self) -> Dict[str, object]:
+        return {"steps_in": self.steps_in, "steps_out": self.steps_out,
+                "rows_saved": self.rows_saved,
+                "reduction_x": round(self.reduction_x, 3),
+                "fused": dict(self.fused)}
+
+    def merge(self, other: "FuseStats") -> None:
+        self.steps_in += other.steps_in
+        self.steps_out += other.steps_out
+        for k, v in other.fused.items():
+            self.fused[k] = self.fused.get(k, 0) + v
+
+
+class _FRow:
+    """One mutable step row while the fuser walks the stream."""
+
+    __slots__ = ("kind", "pos", "del_len", "del_target", "origin_left",
+                 "origin_right", "ins_len", "st", "order_advance", "rank",
+                 "w", "chars")
+
+    def __init__(self, kind, pos, del_len, del_target, origin_left,
+                 origin_right, ins_len, st, order_advance, rank, w,
+                 chars):
+        self.kind = kind; self.pos = pos; self.del_len = del_len
+        self.del_target = del_target; self.origin_left = origin_left
+        self.origin_right = origin_right; self.ins_len = ins_len
+        self.st = st; self.order_advance = order_advance
+        self.rank = rank; self.w = w
+        self.chars = chars  # logical content only (ins_len entries)
+
+    @property
+    def stride(self) -> int:
+        return self.ins_len // self.w if self.w else self.ins_len
+
+    def is_noop(self) -> bool:
+        return self.del_len == 0 and self.ins_len == 0
+
+
+def _try_fuse(cur: _FRow, nxt: _FRow, lmax: int, fuse_w: int,
+              dmax=None):
+    """Try to fold step ``nxt`` into ``cur`` (adjacent in the stream, so
+    nothing intervenes).  Returns the shape name on success (``cur``
+    mutated), else None.  Every rule preserves the device-visible state
+    bit-exactly (final runs/tombstones, by-order origin/rank/char logs,
+    ``next_order``); see the per-rule notes — the correctness burden is
+    carried by ``tests/test_rle_fused.py``'s fused-vs-unfused fuzz.
+
+    Cross-agent fusion (YATA commutativity of causally-independent ops,
+    PAPERS.md Nicolaescu et al.) is admitted exactly where no insert
+    attribution is merged: delete sweeps, remote delete runs, and the
+    delete half of a replace carry no rank into device state (ranks are
+    only logged for inserted chars), so differing authors fuse safely.
+    Insert-bearing rules require equal ranks — a merged run's whole
+    span logs ONE rank — and an op whose origin lands inside the other
+    op's span can never satisfy the chain/contiguity conditions below,
+    so it falls back to its own step (the overlap rejection)."""
+    if nxt.w != 1 or nxt.is_noop() or cur.is_noop():
+        return None
+    loc = KIND_LOCAL
+    # ``dmax`` mirrors the compile-time per-step delete-span bound: a
+    # stream chunked at dmax (e.g. for an engine with a hard per-step
+    # target cap) must not have its delete runs re-merged past it.
+    del_fits = (dmax is None
+                or cur.del_len + nxt.del_len <= dmax)
+
+    # (a→kevin) backwards-contiguous insert burst -> one W-row step:
+    # same position, equal lengths L, ascending orders.  In doc order
+    # the burst is W runs with DESCENDING orders (each patch lands
+    # before its predecessor); origins: shared left, patch k's right =
+    # patch k-1's head (the W-row splice contract, PERF.md §11).
+    if (fuse_w > 1 and cur.kind == loc and nxt.kind == loc
+            and cur.del_len == 0 and nxt.del_len == 0
+            and cur.ins_len > 0 and nxt.ins_len > 0
+            and nxt.pos == cur.pos and cur.rank == nxt.rank
+            and nxt.ins_len == cur.stride
+            and nxt.st == cur.st + cur.ins_len
+            and cur.w + 1 <= fuse_w
+            and cur.ins_len + nxt.ins_len <= lmax):
+        cur.w += 1
+        cur.ins_len += nxt.ins_len
+        cur.order_advance += nxt.order_advance
+        cur.chars = np.concatenate([cur.chars, nxt.chars])
+        return "burst"
+
+    if cur.w != 1:
+        return None
+
+    # (a) forward typing run -> ONE coalesced row: position- and
+    # order-contiguous, same author.  Identical to the host coalescer's
+    # merge (``merge_patches`` semantics): the combined run keeps every
+    # char's order, the implicit origin chain covers the old run heads
+    # (head k's left IS its predecessor char), and the shared raw
+    # successor is unchanged because nothing intervenes.  ``cur`` may
+    # carry a delete (a replace's insert tail extends the same way).
+    if (cur.kind == loc and nxt.kind == loc and nxt.del_len == 0
+            and cur.ins_len > 0 and nxt.ins_len > 0
+            and cur.rank == nxt.rank
+            and nxt.pos == cur.pos + cur.ins_len
+            and nxt.st == cur.st + cur.ins_len
+            and cur.ins_len + nxt.ins_len <= lmax):
+        cur.ins_len += nxt.ins_len
+        cur.order_advance += nxt.order_advance
+        cur.chars = np.concatenate([cur.chars, nxt.chars])
+        return "typing"
+
+    # (b) local delete sweep -> one covered-range walk: forward-delete
+    # (same position) or backspace (next range ends where this one
+    # starts).  Deletes log no rank, so cross-agent sweeps fuse.
+    if (cur.kind == loc and nxt.kind == loc and cur.ins_len == 0
+            and nxt.ins_len == 0 and cur.del_len > 0 and nxt.del_len > 0
+            and del_fits):
+        if nxt.pos == cur.pos:                     # forward-delete run
+            cur.del_len += nxt.del_len
+            cur.order_advance += nxt.order_advance
+            return "sweep"
+        if nxt.pos + nxt.del_len == cur.pos:       # backspace run
+            cur.pos = nxt.pos
+            cur.del_len += nxt.del_len
+            cur.order_advance += nxt.order_advance
+            return "sweep"
+        return None
+
+    # (c) replace fusion: a pure delete followed by a pure insert at
+    # the SAME position is exactly the delete+insert pair one compiled
+    # KIND_LOCAL row already expresses (every engine fires the delete
+    # branch, then the insert branch, with the same arguments the two
+    # separate steps would use).  The delete's author logs nothing, so
+    # the pair fuses across agents too.
+    if (cur.kind == loc and nxt.kind == loc and cur.ins_len == 0
+            and cur.del_len > 0 and nxt.del_len == 0 and nxt.ins_len > 0
+            and nxt.pos == cur.pos):
+        cur.ins_len = nxt.ins_len
+        cur.st = nxt.st
+        cur.rank = nxt.rank
+        cur.order_advance += nxt.order_advance
+        cur.chars = nxt.chars
+        return "replace"
+
+    # (a-remote) remote insert run: the next run's origin_left chains
+    # to this run's tail, shares its origin_right, and continues its
+    # orders — the continued-typing shape ``compile_remote_txns`` emits
+    # for chunked runs, now fused ACROSS txns.  The combined run
+    # integrates at the same cursor: any run the unfused tail-scan
+    # would meet has an origin_left strictly left of the tail (a char's
+    # left origin precedes it; referencing the tail itself would be
+    # causally impossible before this step), so the scan breaks
+    # immediately and the tail lands flush after the head either way.
+    if (cur.kind == KIND_REMOTE_INS and nxt.kind == KIND_REMOTE_INS
+            and cur.ins_len > 0 and nxt.ins_len > 0
+            and cur.rank == nxt.rank
+            and nxt.origin_left == cur.st + cur.ins_len - 1
+            and nxt.origin_right == cur.origin_right
+            and nxt.st == cur.st + cur.ins_len
+            and cur.ins_len + nxt.ins_len <= lmax):
+        cur.ins_len += nxt.ins_len
+        cur.order_advance += nxt.order_advance
+        cur.chars = np.concatenate([cur.chars, nxt.chars])
+        return "remote_ins_run"
+
+    # (b-remote) remote delete run: order-contiguous target ranges
+    # (forward sweep or backspace sweep in order space) tombstone one
+    # union interval; disjoint adjacent ranges applied back-to-back
+    # equal the single interval op, including the dead-run idempotency
+    # accounting.  Rank-free -> cross-agent.
+    if (cur.kind == KIND_REMOTE_DEL and nxt.kind == KIND_REMOTE_DEL
+            and cur.del_len > 0 and nxt.del_len > 0
+            and del_fits):
+        if nxt.del_target == cur.del_target + cur.del_len:
+            cur.del_len += nxt.del_len
+            cur.order_advance += nxt.order_advance
+            return "remote_del_run"
+        if nxt.del_target + nxt.del_len == cur.del_target:
+            cur.del_target = nxt.del_target
+            cur.del_len += nxt.del_len
+            cur.order_advance += nxt.order_advance
+            return "remote_del_run"
+        return None
+
+    return None
+
+
+def fuse_steps(ops: OpTensors, lmax: Optional[int] = None,
+               fuse_w: int = 1, dmax: Optional[int] = None
+               ) -> Tuple[OpTensors, FuseStats]:
+    """Generalized step fusion: one greedy adjacent pass over a compiled
+    stream, folding the fusable shapes (``FUSE_SHAPES``) into multi-op
+    device steps.  The kevin detector (`compile_local_patches(fuse_w)`)
+    only sees backwards bursts inside ONE patch list; this pass runs on
+    any compiled stream — notably the serve batcher's per-doc tick
+    streams, where each event compiles separately and the host
+    coalescer never gets a look (ROADMAP item 4's "one device step per
+    op" tax on typing runs, backspace sweeps, replaces and same-tick
+    cross-agent ops).
+
+    ``fuse_w`` > 1 additionally emits W-row backwards-burst steps
+    (``rows_per_step`` > 1) and requires an engine with the registry
+    ``fused_steps`` splice; the coalescing shapes emit plain W=1 rows
+    every engine accepts.  ``lmax`` caps merged insert lengths (default:
+    the stream's chars width); ``dmax`` caps merged delete spans — pass
+    the bound the stream was compiled with so fusion never re-merges
+    delete runs past an engine's per-step target cap.  Returns
+    ``(fused_ops, FuseStats)``;
+    orders, origins, ranks and chars are preserved column-for-column, so
+    the fused stream is bit-identical in device state to the unfused
+    one (the ``tests/test_rle_fused.py`` contract)."""
+    kinds = np.asarray(ops.kind)
+    assert kinds.ndim == 1, (
+        "fuse_steps takes one unbatched [S] stream; fuse per-doc "
+        "streams BEFORE stack_ops")
+    assert fuse_w >= 1
+    lmax = ops.lmax if lmax is None else min(lmax, ops.lmax)
+    stats = FuseStats(steps_in=int(kinds.shape[0]))
+    if kinds.shape[0] == 0:
+        return ops, stats
+
+    cols = {f: np.asarray(getattr(ops, f if f != "st" else
+                                  "ins_order_start"))
+            for f in ("kind", "pos", "del_len", "del_target",
+                      "origin_left", "origin_right", "ins_len", "st",
+                      "order_advance", "rank")}
+    w_col = np.asarray(ops.rows_per_step)
+    chars = np.asarray(ops.chars)
+
+    def row(i) -> _FRow:
+        il = int(cols["ins_len"][i])
+        return _FRow(*(int(cols[f][i]) for f in
+                       ("kind", "pos", "del_len", "del_target",
+                        "origin_left", "origin_right", "ins_len", "st",
+                        "order_advance", "rank")),
+                     max(int(w_col[i]), 1), chars[i, :il].copy())
+
+    out = _Rows(ops.lmax)
+
+    def emit(r: _FRow) -> None:
+        content = r.chars if r.ins_len else ""
+        out.emit(kind=r.kind, pos=r.pos, del_len=r.del_len,
+                 del_target=r.del_target, origin_left=r.origin_left,
+                 origin_right=r.origin_right, ins_len=r.ins_len,
+                 ins_order_start=r.st, order_advance=r.order_advance,
+                 rank=r.rank, rows=r.w, content=content)
+
+    cur = row(0)
+    for i in range(1, stats.steps_in):
+        nxt = row(i)
+        shape = _try_fuse(cur, nxt, lmax, fuse_w, dmax)
+        if shape is None:
+            emit(cur)
+            cur = nxt
+        else:
+            stats.fused[shape] += 1
+    emit(cur)
+    fused = out.to_tensors()
+    stats.steps_out = fused.num_steps
+    assert (int(np.asarray(fused.order_advance, dtype=np.int64).sum())
+            == int(np.asarray(ops.order_advance, dtype=np.int64).sum())), \
+        "fusion changed the stream's order consumption"
+    return fused, stats
+
+
+def merge_fused_origins(ol_log, or_log, ops: OpTensors,
+                        ol_np, or_np) -> None:
+    """Merge a replay's per-step kernel origins into the by-order logs
+    in place, expanding fused W-row steps (shared by ``rle.rle_to_flat``
+    and ``rle_lanes.lanes_to_flat`` so the chain convention lives
+    ONCE): a fused step's kernel origins are patch 0's — left is
+    SHARED by every sub-run head (orders st + k*L), and rights chain
+    statically (patch k's raw successor at insert time is patch k-1's
+    head, order st + (k-1)*L)."""
+    starts = np.asarray(ops.ins_order_start, dtype=np.int64)
+    ilens = np.asarray(ops.ins_len, dtype=np.int64)
+    ws = np.maximum(np.asarray(ops.rows_per_step, dtype=np.int64), 1)
+    for st, il, w, left, right in zip(starts, ilens, ws, ol_np, or_np):
+        if il > 0:
+            L = il // w
+            for k in range(w):
+                ol_log[st + k * L] = left
+                or_log[st + k * L: st + (k + 1) * L] = (
+                    right if k == 0 else st + (k - 1) * L)
 
 
 def compile_remote_txns(

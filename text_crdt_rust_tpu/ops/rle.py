@@ -49,6 +49,7 @@ oracle diff) is unchanged.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from functools import partial
 
 import jax
@@ -59,7 +60,13 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..common import ROOT_ORDER
-from .batch import KIND_LOCAL, OpTensors, fused_width_checked, prefill_logs
+from .batch import (
+    KIND_LOCAL,
+    OpTensors,
+    fused_width_checked,
+    merge_fused_origins,
+    prefill_logs,
+)
 from .blocked import _cumsum_rows, _lane_scalar, _require, _shift_rows
 from .span_arrays import FlatDoc, I32, U32, make_flat_doc
 
@@ -555,6 +562,34 @@ def make_replayer_rle(
               staged_col(lambda o: o.ins_order_start),
               staged_col(lambda o: o.rows_per_step))
 
+    jitted = _build_call(G, s_pad, batch, capacity, block_k, chunk,
+                         WMAX, interpret)
+
+    def run():
+        ol, orr, ordp, lenp, blk, rows, meta, err = jitted(*staged)
+        results = [
+            RleResult(
+                ordp=ordp[gi * capacity:(gi + 1) * capacity],
+                lenp=lenp[gi * capacity:(gi + 1) * capacity],
+                blkord=blk[gi], rows=rows[gi], meta=meta[gi],
+                ol=ol[gi, :lens[gi]], orr=orr[gi, :lens[gi]], err=err,
+                block_k=block_k, num_blocks=NB, batch=batch)
+            for gi in range(G)
+        ]
+        return results if grouped else results[0]
+
+    return run
+
+
+@functools.lru_cache(maxsize=32)
+def _build_call(G: int, s_pad: int, batch: int, capacity: int,
+                block_k: int, chunk: int, wmax: int, interpret: bool):
+    """Shape-keyed cache (the ``rle_lanes._build_call`` pattern): every
+    same-shape replay shares one traced kernel — a per-call
+    ``jax.jit(lambda ...)`` re-traces the whole interpret program each
+    time, which dominates the fixed-shape test suites."""
+    NB = capacity // block_k
+    NBLp = max(8, NB)
     blocks_per_g = s_pad // chunk
     smem = lambda: pl.BlockSpec(
         (chunk,), lambda g, i: (g * blocks_per_g + i,),
@@ -562,7 +597,7 @@ def make_replayer_rle(
 
     call = pl.pallas_call(
         partial(_rle_kernel, K=block_k, NB=NB, NBL=NBLp, CHUNK=chunk,
-                WMAX=WMAX),
+                WMAX=wmax),
         grid=(G, s_pad // chunk),
         in_specs=[smem(), smem(), smem(), smem(), smem()],
         out_specs=[
@@ -605,22 +640,7 @@ def make_replayer_rle(
         ),
         interpret=interpret,
     )
-    jitted = jax.jit(lambda a, b, c, d, e: call(a, b, c, d, e))
-
-    def run():
-        ol, orr, ordp, lenp, blk, rows, meta, err = jitted(*staged)
-        results = [
-            RleResult(
-                ordp=ordp[gi * capacity:(gi + 1) * capacity],
-                lenp=lenp[gi * capacity:(gi + 1) * capacity],
-                blkord=blk[gi], rows=rows[gi], meta=meta[gi],
-                ol=ol[gi, :lens[gi]], orr=orr[gi, :lens[gi]], err=err,
-                block_k=block_k, num_blocks=NB, batch=batch)
-            for gi in range(G)
-        ]
-        return results if grouped else results[0]
-
-    return run
+    return jax.jit(lambda a, b, c, d, e: call(a, b, c, d, e))
 
 
 def replay_local_rle(ops, capacity: int, **kw):
@@ -739,8 +759,6 @@ def rle_to_flat(
     doc = prefill_logs(doc, ops)
     ol_log = np.array(doc.ol_log)
     or_log = np.array(doc.or_log)
-    starts = np.asarray(ops.ins_order_start, dtype=np.int64)
-    ilens = np.asarray(ops.ins_len, dtype=np.int64)
     ol_np = np.asarray(res.ol)[:, doc_index]
     or_np = np.asarray(res.orr)[:, doc_index]
     if len(ol_np) < ops.num_steps:
@@ -749,19 +767,7 @@ def rle_to_flat(
             f"steps but the result carries {len(ol_np)} — was the engine "
             "built with store_origins=False? (zip truncation would "
             "silently skip the origin merges)")
-    ws = np.maximum(
-        np.asarray(ops.rows_per_step, dtype=np.int64), 1)
-    for st, il, w, left, right in zip(starts, ilens, ws, ol_np, or_np):
-        if il > 0:
-            # A fused step's kernel origins are patch 0's (left is
-            # SHARED by every patch of the burst; rights chain
-            # statically: patch k's raw successor at insert time is
-            # patch k-1's head, order st + (k-1)*L).
-            L = il // w
-            for k in range(w):
-                ol_log[st + k * L] = left
-                or_log[st + k * L: st + (k + 1) * L] = (
-                    right if k == 0 else st + (k - 1) * L)
+    merge_fused_origins(ol_log, or_log, ops, ol_np, or_np)
 
     signed_col = np.zeros(capacity, np.int32)
     signed_col[:n] = flat

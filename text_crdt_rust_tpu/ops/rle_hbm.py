@@ -26,6 +26,7 @@ engines cannot drift. Results reuse ``RleResult``/``rle_to_flat``.
 """
 from __future__ import annotations
 
+import functools
 from functools import partial
 
 import jax
@@ -376,6 +377,42 @@ def make_replayer_rle_hbm(
               staged_col(lambda o: o.ins_order_start),
               staged_col(lambda o: o.rows_per_step))
 
+    jitted = _build_call(G, s_pad, batch, capacity, block_k, chunk,
+                         WMAX, store_origins, interpret)
+
+    def run():
+        ol, orr, ordp, lenp, blk, rows, meta, err = jitted(*staged)
+        # G == 1: hand the planes over as-is — a [0:capacity] slice is a
+        # device COPY, and at kevin scale that transient doubles a 5 GiB
+        # plane and OOMs the chip.
+        results = [
+            RleResult(
+                ordp=ordp if G == 1 else
+                ordp[gi * capacity:(gi + 1) * capacity],
+                lenp=lenp if G == 1 else
+                lenp[gi * capacity:(gi + 1) * capacity],
+                blkord=blk[gi], rows=rows[gi], meta=meta[gi],
+                ol=ol[gi, :lens[gi] if store_origins else 0],
+                orr=orr[gi, :lens[gi] if store_origins else 0], err=err,
+                block_k=block_k, num_blocks=NB, batch=batch)
+            for gi in range(G)
+        ]
+        return results if grouped else results[0]
+
+    return run
+
+
+@functools.lru_cache(maxsize=32)
+def _build_call(G: int, s_pad: int, batch: int, capacity: int,
+                block_k: int, chunk: int, wmax: int,
+                store_origins: bool, interpret: bool):
+    """Shape-keyed cache (the ``rle_lanes._build_call`` pattern): every
+    same-shape replay shares one traced kernel instead of paying a full
+    re-trace per ``make_replayer_rle_hbm`` call."""
+    NB = capacity // block_k
+    NSUP = (NB + SUP - 1) // SUP
+    NBLp = NSUP * SUP
+    NSUPp = max(8, NSUP)
     blocks_per_g = s_pad // chunk
     smem = lambda: pl.BlockSpec(
         (chunk,), lambda g, i: (g * blocks_per_g + i,),
@@ -387,7 +424,7 @@ def make_replayer_rle_hbm(
 
     call = pl.pallas_call(
         partial(_rle_hbm_kernel, K=block_k, NB=NB, NBL=NBLp, NSUP=NSUP,
-                CHUNK=chunk, WMAX=WMAX),
+                CHUNK=chunk, WMAX=wmax),
         grid=(G, blocks_per_g),
         in_specs=[smem(), smem(), smem(), smem(), smem()],
         out_specs=[
@@ -433,28 +470,7 @@ def make_replayer_rle_hbm(
         ),
         interpret=interpret,
     )
-    jitted = jax.jit(lambda a, b, c, d, e: call(a, b, c, d, e))
-
-    def run():
-        ol, orr, ordp, lenp, blk, rows, meta, err = jitted(*staged)
-        # G == 1: hand the planes over as-is — a [0:capacity] slice is a
-        # device COPY, and at kevin scale that transient doubles a 5 GiB
-        # plane and OOMs the chip.
-        results = [
-            RleResult(
-                ordp=ordp if G == 1 else
-                ordp[gi * capacity:(gi + 1) * capacity],
-                lenp=lenp if G == 1 else
-                lenp[gi * capacity:(gi + 1) * capacity],
-                blkord=blk[gi], rows=rows[gi], meta=meta[gi],
-                ol=ol[gi, :lens[gi] if store_origins else 0],
-                orr=orr[gi, :lens[gi] if store_origins else 0], err=err,
-                block_k=block_k, num_blocks=NB, batch=batch)
-            for gi in range(G)
-        ]
-        return results if grouped else results[0]
-
-    return run
+    return jax.jit(lambda a, b, c, d, e: call(a, b, c, d, e))
 
 
 def replay_local_rle_hbm(ops, capacity: int, **kw):

@@ -42,7 +42,14 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..common import ROOT_ORDER
-from .batch import KIND_LOCAL, OpTensors, prefill_logs, require_unfused
+from .batch import (
+    KIND_LOCAL,
+    OpTensors,
+    fused_width,
+    fused_width_checked,
+    merge_fused_origins,
+    prefill_logs,
+)
 from .blocked import _require
 from .span_arrays import FlatDoc, I32, U32, make_flat_doc
 
@@ -65,11 +72,20 @@ def _vrow(arr, r):
     return jnp.sum(jnp.where(idx == r, arr, 0), axis=0, keepdims=True)
 
 
-def _vshift(x, amt):
-    """Rows shifted down by per-lane ``amt`` in {0, 1, 2} ([1, B])."""
-    r1 = pltpu.roll(x, 1, axis=0)
-    r2 = pltpu.roll(x, 2, axis=0)
-    return jnp.where(amt == 0, x, jnp.where(amt == 1, r1, r2))
+def _vshift(x, amt, max_amt: int = 2):
+    """Rows shifted down by per-lane ``amt`` in [0, max_amt] ([1, B]):
+    one static roll per bit, selected per lane (the down-shift twin of
+    ``lane_blocks.vshift_up``).  ``max_amt`` defaults to the plain-
+    splice bound (2: new run + split tail); fused W-row splices pass
+    their static ``WMAX + 1``."""
+    n = x.shape[0]
+    out = x
+    for bit in range(max(max_amt, 1).bit_length()):
+        s = (1 << bit) % n
+        if s:
+            out = jnp.where((amt >> bit) & 1 != 0,
+                            pltpu.roll(out, s, axis=0), out)
+    return out
 
 
 def _live_prefix(bo, bl):
@@ -93,10 +109,11 @@ def _shared_cum_gate(step_has_del, step_has_ins, s_pad: int) -> bool:
 
 def _rle_lanes_kernel(
     pos_ref, dlen_ref, ilen_ref, start_ref,     # [CHUNK,B] VMEM op columns
+    w_ref,                                      # [CHUNK,B] rows_per_step
     ord0_ref, len0_ref, rows0_ref,              # warm-start state inputs
     ol_ref, or_ref,                             # [CHUNK,B] outputs
     ordp, lenp, rowsv, err_ref,                 # state outputs (working)
-    *, CAP: int, CHUNK: int, SHARED_CUM: bool = False,
+    *, CAP: int, CHUNK: int, WMAX: int = 1, SHARED_CUM: bool = False,
 ):
     B = ordp.shape[1]
     # Grid = (lane blocks, chunks): lanes are independent documents, so
@@ -188,8 +205,16 @@ def _rle_lanes_kernel(
         lenp[:] = bl
         rowsv[:] = rowsv[:] + jnp.where(active, a1 + a2, 0)
 
-    def do_insert(k, p, il, st, lv=None, cum=None):
+    def do_insert(k, p, il, st, w, lv=None, cum=None):
         """Per-lane insert splice (active where il > 0).
+
+        ``w`` > 1 is a FUSED backwards-burst step (``rows_per_step``):
+        W run rows of stride ``L = il // w`` land in ONE shift — row j
+        of the spliced window holds orders ``st + il - (j+1)*L`` (patch
+        order DESCENDS in document order), the ``ops.rle``
+        ``_insert_splice`` contract.  ``w == 1`` is exactly the old
+        splice.  The in-place merge stays w==1-only (a burst's first
+        patch merging would be un-done by its second patch's split).
 
         ``lv``/``cum`` may be the step-hoisted PRE-DELETE live prefix:
         valid for this branch's active lanes because the shared-cum
@@ -201,9 +226,9 @@ def _rle_lanes_kernel(
         active = il > 0
         rows = rowsv[:]
 
-        @pl.when(jnp.any(active & (rows + 2 > CAP)))
+        @pl.when(jnp.any(active & (rows + w + 1 > CAP)))
         def _cap():
-            err_ref[0:1, :] = jnp.where(active & (rows + 2 > CAP), 1,
+            err_ref[0:1, :] = jnp.where(active & (rows + w + 1 > CAP), 1,
                                         err_ref[0:1, :])
 
         bo = ordp[:]
@@ -219,7 +244,9 @@ def _rle_lanes_kernel(
 
         left = jnp.where(p == 0, root_u,
                          ((o_r - 1) + (off - 1)).astype(jnp.uint32))
-        mrg = active & (p > 0) & (off == l_r) & ((st + 1) == (o_r + l_r))
+        lrun = il // jnp.maximum(w, 1)
+        mrg = active & (w == 1) & (p > 0) & (off == l_r) & \
+            ((st + 1) == (o_r + l_r))
         is_split = active & (p > 0) & (off < l_r)
 
         nxt_in_blk = _vrow(bo, i_r + 1)
@@ -233,16 +260,18 @@ def _rle_lanes_kernel(
 
         ins_at = jnp.where(p == 0, 0, i_r + 1)
         amt = jnp.where(jnp.logical_not(active) | mrg, 0,
-                        jnp.where(is_split, 2, 1))
-        so = _vshift(bo, amt)
-        sl = _vshift(bl, amt)
+                        w + is_split.astype(jnp.int32))
+        so = _vshift(bo, amt, WMAX + 1)
+        sl = _vshift(bl, amt, WMAX + 1)
         no = jnp.where(idx < ins_at, bo, so)
         nl = jnp.where(idx < ins_at, bl, sl)
         nl = jnp.where(is_split & (idx == i_r), off, nl)
-        new_run = active & jnp.logical_not(mrg) & (idx == ins_at)
-        no = jnp.where(new_run, st + 1, no)
-        nl = jnp.where(new_run, il, nl)
-        tail = is_split & (idx == ins_at + 1)
+        new_run = active & jnp.logical_not(mrg) & (idx >= ins_at) & \
+            (idx < ins_at + w)
+        no = jnp.where(new_run,
+                       st + il - (idx - ins_at + 1) * lrun + 1, no)
+        nl = jnp.where(new_run, lrun, nl)
+        tail = is_split & (idx == ins_at + w)
         no = jnp.where(tail, o_r + off, no)
         nl = jnp.where(tail, l_r - off, nl)
         nl = jnp.where(mrg & (idx == i_r), l_r + il, nl)
@@ -260,6 +289,7 @@ def _rle_lanes_kernel(
         d = dlen_ref[pl.ds(k, 1), :]
         il = ilen_ref[pl.ds(k, 1), :]
         st = start_ref[pl.ds(k, 1), :]
+        w = jnp.maximum(w_ref[pl.ds(k, 1), :], 1)  # pad rows carry 0
 
         if SHARED_CUM:
             # One live prefix serves BOTH branches: the builder proved
@@ -277,7 +307,7 @@ def _rle_lanes_kernel(
 
         @pl.when(jnp.any(il > 0))
         def _():
-            do_insert(k, p, il, st, lv, cum)
+            do_insert(k, p, il, st, w, lv, cum)
 
         return 0
 
@@ -330,7 +360,7 @@ def _lane_tile(B: int) -> int:
 @functools.lru_cache(maxsize=32)
 def _build_call(s_pad: int, B: int, capacity: int, chunk: int,
                 interpret: bool, lane_tile: int | None = None,
-                shared_cum: bool = False):
+                shared_cum: bool = False, wmax: int = 1):
     """Shape-keyed cache: streaming chunks share one compiled kernel
     (a per-chunk pallas_call would re-trace and re-compile ~5-30s each —
     the whole point of warm starts is that chunk N+1 is cheap)."""
@@ -343,9 +373,9 @@ def _build_call(s_pad: int, B: int, capacity: int, chunk: int,
 
     call = pl.pallas_call(
         partial(_rle_lanes_kernel, CAP=capacity, CHUNK=chunk,
-                SHARED_CUM=shared_cum),
+                WMAX=wmax, SHARED_CUM=shared_cum),
         grid=(B // T, s_pad // chunk),
-        in_specs=[col(), col(), col(), col(),
+        in_specs=[col(), col(), col(), col(), col(),
                   whole((capacity, B)), whole((capacity, B)),
                   whole((1, B))],
         out_specs=[
@@ -390,9 +420,12 @@ def make_replayer_lanes(
     _require(bool((kinds == KIND_LOCAL).all()),
              "rle_lanes replays local streams; per-lane remote "
              "streams -> ops.rle_lanes_mixed")
-    require_unfused(ops, "the lanes engines")
     S, B = kinds.shape
     _require(capacity >= 8, "capacity must hold a few runs")
+    wmax = fused_width(ops)
+    _require(wmax + 1 < capacity,
+             f"fused rows_per_step {wmax} cannot fit capacity "
+             f"{capacity}")
     s_pad = max(((S + chunk - 1) // chunk) * chunk, chunk)
 
     def staged_col(get):
@@ -402,7 +435,8 @@ def make_replayer_lanes(
     staged = (staged_col(lambda o: o.pos),
               staged_col(lambda o: o.del_len),
               staged_col(lambda o: o.ins_len),
-              staged_col(lambda o: o.ins_order_start))
+              staged_col(lambda o: o.ins_order_start),
+              staged_col(lambda o: o.rows_per_step))
 
     if init is None:
         init = (jnp.zeros((capacity, B), jnp.int32),
@@ -421,7 +455,7 @@ def make_replayer_lanes(
                   and _shared_cum_gate((dn > 0).any(axis=1),
                                        (iln > 0).any(axis=1), s_pad))
     jitted = _build_call(s_pad, B, capacity, chunk, interpret, lane_tile,
-                         shared_cum)
+                         shared_cum, wmax)
 
     def run(state=None) -> LanesResult:
         ini = init if state is None else _grow_planes(state, capacity, B)
@@ -471,13 +505,14 @@ def replay_lanes(ops: OpTensors, capacity: int, **kw) -> LanesResult:
 
 def _lanes_blocked_kernel(
     pos_ref, dlen_ref, ilen_ref, start_ref,     # [CHUNK, B] VMEM op columns
+    w_ref,                                      # [CHUNK, B] rows_per_step
     ord0_ref, len0_ref, nlog0_ref,              # warm-start state inputs
     blk0_ref, rws0_ref, liv0_ref,
     ol_ref, or_ref,                             # [CHUNK, B] outputs
     ordp, lenp, nlogv, blkord, rws, liv,        # state outputs (working)
     err_ref,
     cumliv,                                     # [NBT, B] scratch prefix
-    *, K: int, NB: int, NBT: int, CHUNK: int,
+    *, K: int, NB: int, NBT: int, CHUNK: int, WMAX: int = 1,
 ):
     from .lane_blocks import (
         gather_block,
@@ -580,11 +615,14 @@ def _lanes_blocked_kernel(
         l = jnp.where(p == 0, 0, slot_of_live_rank(p))
         return l, trow(rws, l)
 
-    def do_insert(k, act, p, il, st):
+    def do_insert(k, act, p, il, st, w):
         """Per-lane blocked insert: descend, gather ONE block, splice
-        <= 3 rows, scatter back (`mutations.rs:17-179`)."""
+        <= w+2 rows, scatter back (`mutations.rs:17-179`).  ``w`` > 1
+        is a FUSED backwards-burst step landing W stride-L rows in one
+        shift (the ``ops.rle`` ``_insert_splice`` contract; WMAX <=
+        K//2 - 1 so the one leaf split below always makes room)."""
         l, r0 = find_insert_slot(p)
-        need = act & (r0 + 2 > K)
+        need = act & (r0 + w + 1 > K)
 
         @pl.when(jnp.any(need))
         def _():
@@ -608,7 +646,9 @@ def _lanes_blocked_kernel(
 
         left = jnp.where(p == 0, root_u,
                          ((o_r - 1) + (off - 1)).astype(jnp.uint32))
-        mrg = act & (p > 0) & (off == l_r) & ((st + 1) == (o_r + l_r))
+        lrun = il // jnp.maximum(w, 1)
+        mrg = act & (w == 1) & (p > 0) & (off == l_r) & \
+            ((st + 1) == (o_r + l_r))
         is_split = act & (p > 0) & (off < l_r)
 
         # Raw successor (`doc.rs:452`): next row of this block, else the
@@ -627,16 +667,18 @@ def _lanes_blocked_kernel(
 
         ins_at = jnp.where(p == 0, 0, i_r + 1)
         amt = jnp.where(jnp.logical_not(act) | mrg, 0,
-                        jnp.where(is_split, 2, 1))
-        so = _vshift(ws_o, amt)
-        sl = _vshift(ws_l, amt)
+                        w + is_split.astype(jnp.int32))
+        so = _vshift(ws_o, amt, WMAX + 1)
+        sl = _vshift(ws_l, amt, WMAX + 1)
         no = jnp.where(kdx < ins_at, ws_o, so)
         nl = jnp.where(kdx < ins_at, ws_l, sl)
         nl = jnp.where(is_split & (kdx == i_r), off, nl)
-        new_run = act & jnp.logical_not(mrg) & (kdx == ins_at)
-        no = jnp.where(new_run, st + 1, no)
-        nl = jnp.where(new_run, il, nl)
-        tail = is_split & (kdx == ins_at + 1)
+        new_run = act & jnp.logical_not(mrg) & (kdx >= ins_at) & \
+            (kdx < ins_at + w)
+        no = jnp.where(new_run,
+                       st + il - (kdx - ins_at + 1) * lrun + 1, no)
+        nl = jnp.where(new_run, lrun, nl)
+        tail = is_split & (kdx == ins_at + w)
         no = jnp.where(tail, o_r + off, no)
         nl = jnp.where(tail, l_r - off, nl)
         nl = jnp.where(mrg & (kdx == i_r), l_r + il, nl)
@@ -718,6 +760,7 @@ def _lanes_blocked_kernel(
         d = dlen_ref[pl.ds(k, 1), :]
         il = ilen_ref[pl.ds(k, 1), :]
         st = start_ref[pl.ds(k, 1), :]
+        w = jnp.maximum(w_ref[pl.ds(k, 1), :], 1)  # pad rows carry 0
 
         @pl.when(jnp.any(d > 0))
         def _():
@@ -725,7 +768,7 @@ def _lanes_blocked_kernel(
 
         @pl.when(jnp.any(il > 0))
         def _():
-            do_insert(k, il > 0, p, il, st)
+            do_insert(k, il > 0, p, il, st, w)
 
         return 0
 
@@ -775,7 +818,7 @@ class BlockedLanesResult:
 @functools.lru_cache(maxsize=32)
 def _build_blocked_call(s_pad: int, B: int, capacity: int, block_k: int,
                         chunk: int, interpret: bool,
-                        lane_tile: int | None = None):
+                        lane_tile: int | None = None, wmax: int = 1):
     """Shape-keyed cache for the blocked kernel (streaming chunks of one
     geometry share one compiled kernel)."""
     K = block_k
@@ -789,9 +832,10 @@ def _build_blocked_call(s_pad: int, B: int, capacity: int, block_k: int,
         (rows, T), lambda lb, i: (0, lb), memory_space=pltpu.VMEM)
 
     call = pl.pallas_call(
-        partial(_lanes_blocked_kernel, K=K, NB=NB, NBT=NBT, CHUNK=chunk),
+        partial(_lanes_blocked_kernel, K=K, NB=NB, NBT=NBT, CHUNK=chunk,
+                WMAX=wmax),
         grid=(B // T, s_pad // chunk),
-        in_specs=[col(), col(), col(), col(),
+        in_specs=[col(), col(), col(), col(), col(),
                   whole(capacity), whole(capacity), whole(1),
                   whole(NBT), whole(NBT), whole(NBT)],
         out_specs=[
@@ -845,12 +889,12 @@ def make_replayer_lanes_blocked(
     _require(bool((kinds == KIND_LOCAL).all()),
              "rle_lanes replays local streams; per-lane remote "
              "streams -> ops.rle_lanes_mixed")
-    require_unfused(ops, "the lanes engines")
     S, B = kinds.shape
     _require(block_k >= 8, "block_k must hold a few runs")
     _require(capacity % block_k == 0,
              f"capacity ({capacity}) must be a multiple of block_k "
              f"({block_k})")
+    wmax = fused_width_checked([ops], block_k)
     s_pad = max(((S + chunk - 1) // chunk) * chunk, chunk)
 
     def staged_col(get):
@@ -860,7 +904,8 @@ def make_replayer_lanes_blocked(
     staged = (staged_col(lambda o: o.pos),
               staged_col(lambda o: o.del_len),
               staged_col(lambda o: o.ins_len),
-              staged_col(lambda o: o.ins_order_start))
+              staged_col(lambda o: o.ins_order_start),
+              staged_col(lambda o: o.rows_per_step))
 
     NBT = max(8, capacity // block_k)
     if init is None:
@@ -868,7 +913,7 @@ def make_replayer_lanes_blocked(
     else:
         init = _grow_blocked_state(init, capacity, block_k, B)
     jitted = _build_blocked_call(s_pad, B, capacity, block_k, chunk,
-                                 interpret, lane_tile)
+                                 interpret, lane_tile, wmax)
 
     def run(state=None) -> BlockedLanesResult:
         ini = init if state is None else _grow_blocked_state(
@@ -989,14 +1034,9 @@ def lanes_to_flat(
     doc = prefill_logs(doc, per_doc)
     ol_log = np.array(doc.ol_log)
     or_log = np.array(doc.or_log)
-    starts = np.asarray(per_doc.ins_order_start, dtype=np.int64)
-    ilens = np.asarray(per_doc.ins_len, dtype=np.int64)
     ol_np = np.asarray(res.ol)[:, doc_index]
     or_np = np.asarray(res.orr)[:, doc_index]
-    for st, il, left, right in zip(starts, ilens, ol_np, or_np):
-        if il > 0:
-            ol_log[st] = left
-            or_log[st: st + il] = right
+    merge_fused_origins(ol_log, or_log, per_doc, ol_np, or_np)
 
     signed_col = np.zeros(capacity, np.int32)
     signed_col[:n] = flat

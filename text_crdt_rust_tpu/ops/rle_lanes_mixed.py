@@ -66,7 +66,8 @@ from .batch import (
     KIND_REMOTE_INS,
     OpTensors,
     _prefill_scatter,
-    require_unfused,
+    fused_width,
+    fused_width_checked,
 )
 from .blocked import _require
 from .rle_lanes import (
@@ -82,9 +83,28 @@ from .rle_lanes import (
 TAB_UNKNOWN = -2  # by-order table sentinel: entry not yet known
 
 
+def _fused_table_writes(oll, orl, oidx, act, st, il, lrun, left, right):
+    """By-order table upkeep for a (possibly fused) local insert —
+    shared by the un-blocked and blocked mixed kernels (each binds its
+    own ``oll``/``orl``/``oidx`` via ``partial``): every sub-run head
+    (orders st + k*L) logs the SHARED left neighbour; sub-run k's span
+    logs origin_right = patch k-1's head (k=0 keeps the raw successor)
+    — exactly what the unfused per-patch steps would have written, so
+    later YATA scans read identical origins.  w == 1 (lrun == il)
+    degenerates to the old head write + whole-span right."""
+    span = act & (oidx >= st) & (oidx < st + il)
+    qoff = oidx - st
+    ls = jnp.maximum(lrun, 1)
+    oll[:] = jnp.where(span & (qoff % ls == 0), left, oll[:])
+    orl[:] = jnp.where(
+        span, jnp.where(qoff < ls, right,
+                        st + (qoff // ls - 1) * ls), orl[:])
+
+
 def _mixed_lanes_kernel(
     kind_ref, pos_ref, dlen_ref, dtgt_ref, olop_ref, orop_ref, rk_ref,
     ilen_ref, start_ref,                        # [CHUNK, B] VMEM op columns
+    w_ref,                                      # [CHUNK, B] rows_per_step
     ord0_ref, len0_ref, rows0_ref,              # warm-start state inputs
     oll0_ref, orl0_ref,                         # prior table state [OCAP, B]
     olld_ref, orld_ref,                         # this stream's prefill delta
@@ -93,7 +113,8 @@ def _mixed_lanes_kernel(
     ordp, lenp, rowsv,                          # state outputs (working)
     oll, orl,                                   # table outputs (working)
     err_ref,
-    *, CAP: int, OCAP: int, CHUNK: int, SHARED_CUM: bool = False,
+    *, CAP: int, OCAP: int, CHUNK: int, WMAX: int = 1,
+    SHARED_CUM: bool = False,
 ):
     B = ordp.shape[1]
     i = pl.program_id(1)
@@ -175,11 +196,14 @@ def _mixed_lanes_kernel(
 
     # ---- local ops (rle_lanes paths + table upkeep) ---------------------
 
-    def flag_capacity(act):
-        @pl.when(jnp.any(act & (rowsv[:] + 2 > CAP)))
+    def flag_capacity(act, need=2):
+        """Flag err row 0 where the lane lacks ``need`` spare rows
+        (delete splits need 2; a fused W-row insert needs w + 1)."""
+        over = act & (rowsv[:] + need > CAP)
+
+        @pl.when(jnp.any(over))
         def _cap():
-            err_ref[0:1, :] = jnp.where(act & (rowsv[:] + 2 > CAP), 1,
-                                        err_ref[0:1, :])
+            err_ref[0:1, :] = jnp.where(over, 1, err_ref[0:1, :])
 
     def apply_partial(a, i_p, bo, bl, cs, ce):
         """Split run row ``i_p`` around its covered sub-range
@@ -245,15 +269,19 @@ def _mixed_lanes_kernel(
         lenp[:] = bl
         rowsv[:] = rowsv[:] + jnp.where(act, a1 + a2, 0)
 
-    def do_local_insert(act, k, p, il, st, lv=None, cum=None):
+    fused_table_writes = partial(_fused_table_writes, oll, orl, oidx)
+
+    def do_local_insert(act, k, p, il, st, w, lv=None, cum=None):
         """rle_lanes.do_insert + by-order table upkeep (the origins a
         local insert discovers at apply time, `doc.rs:447-453`).
+        ``w`` > 1 is a FUSED backwards-burst step: W stride-L rows in
+        one shift, the ``ops.rle`` ``_insert_splice`` contract.
         ``lv``/``cum`` may be the step-hoisted PRE-DELETE live prefix
         (valid: shared-cum mode excludes same-lane delete+insert
         steps); ``bo``/``bl`` stay FRESH so the whole-plane writes
         preserve the delete branch's results on other lanes."""
-        flag_capacity(act)
         rows = rowsv[:]
+        flag_capacity(act, w + 1)
         bo = ordp[:]
         bl = lenp[:]
         if cum is None:
@@ -266,7 +294,9 @@ def _mixed_lanes_kernel(
         off = local - (_vrow(cum, i_r) - _vrow(lv, i_r))
 
         left = jnp.where(p == 0, root_i, (o_r - 1) + (off - 1))
-        mrg = act & (p > 0) & (off == l_r) & ((st + 1) == (o_r + l_r))
+        lrun = il // jnp.maximum(w, 1)
+        mrg = act & (w == 1) & (p > 0) & (off == l_r) & \
+            ((st + 1) == (o_r + l_r))
         is_split = act & (p > 0) & (off < l_r)
 
         nxt_in_blk = _vrow(bo, i_r + 1)
@@ -279,16 +309,18 @@ def _mixed_lanes_kernel(
 
         ins_at = jnp.where(p == 0, 0, i_r + 1)
         amt = jnp.where(jnp.logical_not(act) | mrg, 0,
-                        jnp.where(is_split, 2, 1))
-        so = _vshift(bo, amt)
-        sl = _vshift(bl, amt)
+                        w + is_split.astype(jnp.int32))
+        so = _vshift(bo, amt, WMAX + 1)
+        sl = _vshift(bl, amt, WMAX + 1)
         no = jnp.where(idx < ins_at, bo, so)
         nl = jnp.where(idx < ins_at, bl, sl)
         nl = jnp.where(is_split & (idx == i_r), off, nl)
-        new_run = act & jnp.logical_not(mrg) & (idx == ins_at)
-        no = jnp.where(new_run, st + 1, no)
-        nl = jnp.where(new_run, il, nl)
-        tail = is_split & (idx == ins_at + 1)
+        new_run = act & jnp.logical_not(mrg) & (idx >= ins_at) & \
+            (idx < ins_at + w)
+        no = jnp.where(new_run,
+                       st + il - (idx - ins_at + 1) * lrun + 1, no)
+        nl = jnp.where(new_run, lrun, nl)
+        tail = is_split & (idx == ins_at + w)
         no = jnp.where(tail, o_r + off, no)
         nl = jnp.where(tail, l_r - off, nl)
         nl = jnp.where(mrg & (idx == i_r), l_r + il, nl)
@@ -296,8 +328,7 @@ def _mixed_lanes_kernel(
         lenp[:] = nl
         rowsv[:] = rows + amt
 
-        t_write(oll, act, st, left)
-        t_write_run(orl, act, st, il, right)
+        fused_table_writes(act, st, il, lrun, left, right)
         ol_ref[pl.ds(k, 1), :] = jnp.where(
             act, left.astype(jnp.uint32), ol_ref[pl.ds(k, 1), :])
         or_ref[pl.ds(k, 1), :] = jnp.where(
@@ -485,6 +516,7 @@ def _mixed_lanes_kernel(
         d = dlen_ref[pl.ds(k, 1), :]
         il = ilen_ref[pl.ds(k, 1), :]
         st = start_ref[pl.ds(k, 1), :]
+        w = jnp.maximum(w_ref[pl.ds(k, 1), :], 1)  # pad rows carry 0
 
         act_ld = (kind == KIND_LOCAL) & (d > 0)
         act_li = (kind == KIND_LOCAL) & (il > 0)
@@ -505,7 +537,7 @@ def _mixed_lanes_kernel(
 
         @pl.when(jnp.any(act_li))
         def _():
-            do_local_insert(act_li, k, p, il, st, lv, cum)
+            do_local_insert(act_li, k, p, il, st, w, lv, cum)
 
         @pl.when(jnp.any(act_ri))
         def _():
@@ -572,7 +604,7 @@ def lane_tables(stacked: OpTensors, ocap: int):
 @functools.lru_cache(maxsize=32)
 def _build_call(s_pad: int, B: int, capacity: int, ocap: int, chunk: int,
                 interpret: bool, lane_tile: int | None = None,
-                shared_cum: bool = False):
+                shared_cum: bool = False, wmax: int = 1):
     """Shape-keyed cache (streaming chunks share one compiled kernel)."""
     T = lane_tile or _lane_tile(B)
     _require(B % T == 0, f"lane_tile {T} must divide batch {B}")
@@ -583,9 +615,9 @@ def _build_call(s_pad: int, B: int, capacity: int, ocap: int, chunk: int,
 
     call = pl.pallas_call(
         partial(_mixed_lanes_kernel, CAP=capacity, OCAP=ocap,
-                CHUNK=chunk, SHARED_CUM=shared_cum),
+                CHUNK=chunk, WMAX=wmax, SHARED_CUM=shared_cum),
         grid=(B // T, s_pad // chunk),
-        in_specs=[col() for _ in range(9)] + [
+        in_specs=[col() for _ in range(10)] + [
             whole(capacity), whole(capacity), whole(1),
             whole(ocap), whole(ocap),           # prior table state
             whole(ocap), whole(ocap),           # prefill delta
@@ -642,9 +674,12 @@ def make_replayer_lanes_mixed(
     kinds = np.asarray(ops.kind)
     _require(kinds.ndim == 2, "rle_lanes_mixed takes stacked per-doc "
              "streams ([S, B] columns; see batch.stack_ops)")
-    require_unfused(ops, "the lanes engines")
     S, B = kinds.shape
     _require(capacity >= 8, "capacity must hold a few runs")
+    wmax = fused_width(ops)
+    _require(wmax + 1 < capacity,
+             f"fused rows_per_step {wmax} cannot fit capacity "
+             f"{capacity}")
     s_pad = max(((S + chunk - 1) // chunk) * chunk, chunk)
 
     adv = np.asarray(ops.order_advance, dtype=np.int64).sum(axis=0)
@@ -663,7 +698,7 @@ def make_replayer_lanes_mixed(
         lambda o: o.kind, lambda o: o.pos, lambda o: o.del_len,
         lambda o: o.del_target, lambda o: o.origin_left,
         lambda o: o.origin_right, lambda o: o.rank, lambda o: o.ins_len,
-        lambda o: o.ins_order_start))
+        lambda o: o.ins_order_start, lambda o: o.rows_per_step))
 
     olld, orld, rkl0 = lane_tables(ops, ocap)
     if rkl is None:
@@ -695,7 +730,7 @@ def make_replayer_lanes_mixed(
                   and _shared_cum_gate(ld.any(axis=1), li.any(axis=1),
                                        s_pad))
     jitted = _build_call(s_pad, B, capacity, ocap, chunk,
-                         interpret, lane_tile, shared_cum)
+                         interpret, lane_tile, shared_cum, wmax)
     deltas = (jnp.asarray(olld), jnp.asarray(orld), jnp.asarray(rkl))
 
     def run(state=None) -> LanesMixedResult:
@@ -757,6 +792,7 @@ def replay_lanes_mixed(ops: OpTensors, capacity: int,
 def _mixed_lanes_blocked_kernel(
     kind_ref, pos_ref, dlen_ref, dtgt_ref, olop_ref, orop_ref, rk_ref,
     ilen_ref, start_ref,                        # [CHUNK, B] VMEM op columns
+    w_ref,                                      # [CHUNK, B] rows_per_step
     ord0_ref, len0_ref, nlog0_ref,              # warm-start state inputs
     blk0_ref, rws0_ref, liv0_ref, raw0_ref,
     oll0_ref, orl0_ref,                         # prior table state
@@ -771,6 +807,7 @@ def _mixed_lanes_blocked_kernel(
     err_ref,
     cumliv, cumraw,                             # [NBT, B] scratch prefixes
     *, K: int, NB: int, NBT: int, CAP: int, OCAP: int, CHUNK: int,
+    WMAX: int = 1,
 ):
     from .lane_blocks import (
         gather_block,
@@ -1115,10 +1152,15 @@ def _mixed_lanes_blocked_kernel(
             err_ref[1:2, :] = jnp.where(act & (rem > 0), 1,
                                         err_ref[1:2, :])
 
-    def do_local_insert(act, k, p, il, st):
-        """Blocked per-lane live-rank insert + by-order table upkeep."""
+    fused_table_writes = partial(_fused_table_writes, oll, orl, oidx)
+
+    def do_local_insert(act, k, p, il, st, w):
+        """Blocked per-lane live-rank insert + by-order table upkeep.
+        ``w`` > 1 is a FUSED backwards-burst step: W stride-L rows in
+        one shift (the ``ops.rle`` ``_insert_splice`` contract; WMAX
+        <= K//2 - 1 so the one leaf split always makes room)."""
         l = jnp.where(p == 0, 0, slot_of(cumliv, p, strict=True))
-        need = act & (trow(rws, l) + 2 > K)
+        need = act & (trow(rws, l) + w + 1 > K)
 
         @pl.when(jnp.any(need))
         def _():
@@ -1143,7 +1185,9 @@ def _mixed_lanes_blocked_kernel(
         off = local - (_vrow(cum, i_r) - _vrow(lv, i_r))
 
         left = jnp.where(p == 0, root_i, (o_r - 1) + (off - 1))
-        mrg = act & (p > 0) & (off == l_r) & ((st + 1) == (o_r + l_r))
+        lrun = il // jnp.maximum(w, 1)
+        mrg = act & (w == 1) & (p > 0) & (off == l_r) & \
+            ((st + 1) == (o_r + l_r))
         is_split = act & (p > 0) & (off < l_r)
 
         nxt_in_blk = _vrow(ws_o, i_r + 1)
@@ -1159,16 +1203,18 @@ def _mixed_lanes_blocked_kernel(
 
         ins_at = jnp.where(p == 0, 0, i_r + 1)
         amt = jnp.where(jnp.logical_not(act) | mrg, 0,
-                        jnp.where(is_split, 2, 1))
-        so = _vshift(ws_o, amt)
-        sl = _vshift(ws_l, amt)
+                        w + is_split.astype(jnp.int32))
+        so = _vshift(ws_o, amt, WMAX + 1)
+        sl = _vshift(ws_l, amt, WMAX + 1)
         no = jnp.where(kdx < ins_at, ws_o, so)
         nl = jnp.where(kdx < ins_at, ws_l, sl)
         nl = jnp.where(is_split & (kdx == i_r), off, nl)
-        new_run = act & jnp.logical_not(mrg) & (kdx == ins_at)
-        no = jnp.where(new_run, st + 1, no)
-        nl = jnp.where(new_run, il, nl)
-        tail = is_split & (kdx == ins_at + 1)
+        new_run = act & jnp.logical_not(mrg) & (kdx >= ins_at) & \
+            (kdx < ins_at + w)
+        no = jnp.where(new_run,
+                       st + il - (kdx - ins_at + 1) * lrun + 1, no)
+        nl = jnp.where(new_run, lrun, nl)
+        tail = is_split & (kdx == ins_at + w)
         no = jnp.where(tail, o_r + off, no)
         nl = jnp.where(tail, l_r - off, nl)
         nl = jnp.where(mrg & (kdx == i_r), l_r + il, nl)
@@ -1183,8 +1229,7 @@ def _mixed_lanes_blocked_kernel(
         cumraw[:] = jnp.where(act & (tidx >= l), cumraw[:] + il,
                               cumraw[:])
 
-        t_write(oll, act, st, left)
-        t_write_run(orl, act, st, il, right)
+        fused_table_writes(act, st, il, lrun, left, right)
         t_write_run(ordblk, act, st, il, b)
         ol_ref[pl.ds(k, 1), :] = jnp.where(
             act, left.astype(jnp.uint32), ol_ref[pl.ds(k, 1), :])
@@ -1452,6 +1497,7 @@ def _mixed_lanes_blocked_kernel(
         d = dlen_ref[pl.ds(k, 1), :]
         il = ilen_ref[pl.ds(k, 1), :]
         st = start_ref[pl.ds(k, 1), :]
+        w = jnp.maximum(w_ref[pl.ds(k, 1), :], 1)  # pad rows carry 0
 
         act_ld = (kind == KIND_LOCAL) & (d > 0)
         act_li = (kind == KIND_LOCAL) & (il > 0)
@@ -1464,7 +1510,7 @@ def _mixed_lanes_blocked_kernel(
 
         @pl.when(jnp.any(act_li))
         def _():
-            do_local_insert(act_li, k, p, il, st)
+            do_local_insert(act_li, k, p, il, st, w)
 
         @pl.when(jnp.any(act_ri))
         def _():
@@ -1533,7 +1579,7 @@ class BlockedLanesMixedResult:
 @functools.lru_cache(maxsize=32)
 def _build_blocked_call(s_pad: int, B: int, capacity: int, block_k: int,
                         ocap: int, chunk: int, interpret: bool,
-                        lane_tile: int | None = None):
+                        lane_tile: int | None = None, wmax: int = 1):
     """Shape-keyed cache for the blocked mixed kernel."""
     K = block_k
     NB = capacity // K
@@ -1547,9 +1593,9 @@ def _build_blocked_call(s_pad: int, B: int, capacity: int, block_k: int,
 
     call = pl.pallas_call(
         partial(_mixed_lanes_blocked_kernel, K=K, NB=NB, NBT=NBT,
-                CAP=capacity, OCAP=ocap, CHUNK=chunk),
+                CAP=capacity, OCAP=ocap, CHUNK=chunk, WMAX=wmax),
         grid=(B // T, s_pad // chunk),
-        in_specs=[col() for _ in range(9)] + [
+        in_specs=[col() for _ in range(10)] + [
             whole(capacity), whole(capacity), whole(1),
             whole(NBT), whole(NBT), whole(NBT), whole(NBT),
             whole(ocap), whole(ocap), whole(ocap),  # prior table state
@@ -1613,12 +1659,12 @@ def make_replayer_lanes_mixed_blocked(
     kinds = np.asarray(ops.kind)
     _require(kinds.ndim == 2, "rle_lanes_mixed takes stacked per-doc "
              "streams ([S, B] columns; see batch.stack_ops)")
-    require_unfused(ops, "the lanes engines")
     S, B = kinds.shape
     _require(block_k >= 8, "block_k must hold a few runs")
     _require(capacity % block_k == 0,
              f"capacity ({capacity}) must be a multiple of block_k "
              f"({block_k})")
+    wmax = fused_width_checked([ops], block_k)
     s_pad = max(((S + chunk - 1) // chunk) * chunk, chunk)
 
     adv = np.asarray(ops.order_advance, dtype=np.int64).sum(axis=0)
@@ -1637,7 +1683,7 @@ def make_replayer_lanes_mixed_blocked(
         lambda o: o.kind, lambda o: o.pos, lambda o: o.del_len,
         lambda o: o.del_target, lambda o: o.origin_left,
         lambda o: o.origin_right, lambda o: o.rank, lambda o: o.ins_len,
-        lambda o: o.ins_order_start))
+        lambda o: o.ins_order_start, lambda o: o.rows_per_step))
 
     olld, orld, rkl0 = lane_tables(ops, ocap)
     if rkl is None:
@@ -1653,7 +1699,7 @@ def make_replayer_lanes_mixed_blocked(
     else:
         init = _grow_mixed_blocked_state(init, capacity, block_k, ocap, B)
     jitted = _build_blocked_call(s_pad, B, capacity, block_k, ocap,
-                                 chunk, interpret, lane_tile)
+                                 chunk, interpret, lane_tile, wmax)
     deltas = (jnp.asarray(olld), jnp.asarray(orld), jnp.asarray(rkl))
 
     def run(state=None) -> BlockedLanesMixedResult:

@@ -64,15 +64,20 @@ class FlatLaneBackend:
     """
 
     engine = "flat"
+    # The flat engine has no W-row splice (``require_unfused``): the
+    # batcher's tick fusion still coalesces shapes into plain W=1 rows
+    # for it, but never emits multi-row burst steps.
+    max_fuse_w = 1
 
     def __init__(self, lanes: int, capacity: int, order_capacity: int,
                  lmax: int, block_k: Optional[int] = None,
-                 interpret: Optional[bool] = None):
+                 interpret: Optional[bool] = None, fuse_w: int = 1):
         import jax.numpy as jnp
 
-        # block_k / interpret are lane-backend-constructor surface (the
-        # blocked backend consumes them); the flat engine has no blocks
-        # and is plain jax.numpy, so both are accepted and ignored.
+        # block_k / interpret / fuse_w are lane-backend-constructor
+        # surface (the blocked backend consumes them); the flat engine
+        # has no blocks and no W-row splice, so they are accepted and
+        # ignored.
         self.lanes = lanes
         self.capacity = capacity
         self.order_capacity = order_capacity
@@ -157,7 +162,8 @@ class FlatLaneBackend:
 def make_lane_backend(engine: str, *, lanes: int, capacity: int,
                       order_capacity: int, lmax: int,
                       block_k: int = 32,
-                      interpret: Optional[bool] = None):
+                      interpret: Optional[bool] = None,
+                      fuse_w: int = 1):
     """Registry-driven lane-backend construction: ``engine`` must be
     registered for the ``serve`` config in ``config.ENGINE_REGISTRY``
     AND carry a ``serve_backend`` entry naming its backend class —
@@ -183,7 +189,7 @@ def make_lane_backend(engine: str, *, lanes: int, capacity: int,
         f"text_crdt_rust_tpu.{mod_path}"), cls_name)
     return cls(lanes=lanes, capacity=capacity,
                order_capacity=order_capacity, lmax=lmax,
-               block_k=block_k, interpret=interpret)
+               block_k=block_k, interpret=interpret, fuse_w=fuse_w)
 
 
 def oracle_signed(oracle) -> np.ndarray:
@@ -241,13 +247,25 @@ class ContinuousBatcher:
 
     def __init__(self, router: ShardRouter, residency, *,
                  step_buckets: Tuple[int, ...], lmax: int,
-                 counters: Optional[Counters] = None):
+                 counters: Optional[Counters] = None,
+                 fuse_steps: bool = False, fuse_w: int = 1):
         assert tuple(sorted(step_buckets)) == tuple(step_buckets)
         self.router = router
         self.residency = residency
         self.step_buckets = tuple(step_buckets)
         self.lmax = lmax
         self.counters = counters if counters is not None else Counters()
+        # Generalized tick-stream fusion (``ops.batch.fuse_steps``,
+        # ISSUE 6): each lane doc's drained tick stream is fused before
+        # the capacity probe and stacking — typing runs / sweeps /
+        # replaces / remote runs coalesce into plain rows every backend
+        # accepts; backwards bursts additionally fuse into W-row steps
+        # up to the backend's ``max_fuse_w`` (1 on backends without the
+        # W-row splice).  Fewer steps per doc-tick -> more docs fit a
+        # fixed [S, B] bucket, the batching win ``fuse_stats`` tracks.
+        self.fuse_steps = fuse_steps
+        self.fuse_w = max(1, fuse_w)
+        self.fuse_stats = B.FuseStats()
         self.latency_samples: List[float] = []
         self.tick_wall_samples: List[float] = []  # per-tick wall seconds
         # Optional per-doc compiled-stream tap: called as
@@ -435,6 +453,27 @@ class ContinuousBatcher:
                 applied_events.extend(applied)
                 stats["events_applied"] += len(applied)
                 stats["ops_applied"] += sum(e.items for e in applied)
+                fs = None
+                if (self.fuse_steps and doc.in_lane
+                        and stream is not None):
+                    if stream.num_steps > 1:
+                        # Fuse the doc's tick stream BEFORE the
+                        # capacity probe and stacking: per-event
+                        # compilation never sees adjacent events, so
+                        # this is where typing runs / sweeps / replaces
+                        # / same-tick remote runs collapse
+                        # (bit-identical stream, fewer rows).
+                        stream, fs = B.fuse_steps(
+                            stream,
+                            fuse_w=min(self.fuse_w,
+                                       getattr(backend, "max_fuse_w",
+                                               1)))
+                    else:
+                        # Single-step streams can't fuse but ARE device
+                        # steps: count them so steps_total/ops_per_step
+                        # measure the whole run, not the fused subset.
+                        fs = B.FuseStats(steps_in=stream.num_steps,
+                                         steps_out=stream.num_steps)
                 if doc.in_lane and stream is not None:
                     # Lane-capacity probe AFTER the oracle applied (the
                     # oracle is truth): overflow degrades to host-only,
@@ -446,6 +485,13 @@ class ContinuousBatcher:
                             self.step_trace(doc.doc_id, stream)
                         lane_streams[doc.lane] = stream
                         stats["steps"] += stream.num_steps
+                        if fs is not None:
+                            # Count fusion only for streams that WILL
+                            # run as device steps: a probe failure
+                            # degrades to host-only, and its rows must
+                            # not inflate the exported device-step
+                            # counters.
+                            self.fuse_stats.merge(fs)
                     else:
                         self.residency.degrade(
                             doc, f"lane capacity overflow: {doc.oracle.n} "

@@ -71,7 +71,7 @@ class LanesMixedLaneBackend:
 
     def __init__(self, lanes: int, capacity: int, order_capacity: int,
                  lmax: int, block_k: int = 64,
-                 interpret: Optional[bool] = None):
+                 interpret: Optional[bool] = None, fuse_w: int = 1):
         from ..config import lane_block_geometry
 
         self.lanes = lanes
@@ -79,6 +79,10 @@ class LanesMixedLaneBackend:
         self.block_k = max(8, min(block_k, capacity))
         self.capacity, self.NB, self.NBT = lane_block_geometry(
             capacity, self.block_k)
+        # Widest fused burst step this backend admits (the batcher's
+        # generalized tick fusion asks): clamped by the one-split
+        # headroom rule WMAX <= K//2 - 1 (``batch.fused_width_checked``).
+        self.max_fuse_w = max(1, min(fuse_w, self.block_k // 2 - 1))
         self.order_capacity = ((order_capacity + 7) // 8) * 8
         # Pallas needs the interpreter off-TPU; on silicon run compiled.
         self.interpret = (jax.default_backend() != "tpu"
@@ -105,11 +109,15 @@ class LanesMixedLaneBackend:
         """Max run rows a lane may hold such that the kernel can never
         run out of blocks: out-of-blocks requires every block allocated,
         and all but one block (seeded or split-born) holds at least
-        ``(K-1)//2`` rows, so staying below ``(NB-1)*(K-1)//2`` rows
-        (minus 2 rows of slack; the probes bound each stream's FULL
-        growth before it applies) keeps the device capacity flag
-        unreachable."""
-        return max(0, (self.NB - 1) * ((self.block_k - 1) // 2) - 2)
+        ``(K - WMAX) // 2`` rows — a fused W-row splice fires its leaf
+        split at ``r0 + W + 1 > K``, so the kept half of a split block
+        can be as small as ``(K - WMAX) // 2`` (WMAX = 1 recovers the
+        unfused ``(K-1)//2`` fill).  Staying below
+        ``(NB-1) * (K-WMAX)//2`` rows (minus 2 rows of slack; the
+        probes bound each stream's FULL growth before it applies) keeps
+        the device capacity flag unreachable."""
+        min_fill = (self.block_k - self.max_fuse_w) // 2
+        return max(0, (self.NB - 1) * min_fill - 2)
 
     def _orders_fit(self, next_order: int) -> bool:
         return next_order <= self.order_capacity - self.lmax
@@ -128,23 +136,30 @@ class LanesMixedLaneBackend:
                 and self._orders_fit(oracle.get_next_order()))
 
     @staticmethod
-    def _stream_growth(del_len, ins_len) -> np.ndarray:
+    def _stream_growth(del_len, ins_len, rows_per_step=None) -> np.ndarray:
         """Sound run-row growth bound of a stream, per trailing lane
         axis: each ACTIVE branch of a step splices at most +2 rows (a
         3-way delete split, or an insert split), and a compiled local
         REPLACE step fires both branches — so the bound is 2 rows per
         active branch, NOT 2 per step (a 2/step bound is reachable by
         ``submit_local(..., del_len=k, ins_content=...)``, and crossing
-        it would make the kernel's out-of-blocks flag reachable)."""
+        it would make the kernel's out-of-blocks flag reachable).  A
+        FUSED insert branch (``rows_per_step`` W > 1) splices up to
+        W + 1 rows (W new runs + one split tail); W = 1 keeps the old
+        +2 (new run + split tail)."""
         d = np.asarray(del_len) > 0
         i = np.asarray(ins_len) > 0
-        return 2 * (d.astype(np.int64) + i.astype(np.int64)).sum(axis=0)
+        w = (np.maximum(np.asarray(rows_per_step, dtype=np.int64), 1)
+             if rows_per_step is not None else np.int64(1))
+        ins_growth = np.maximum(w + 1, 2) * i.astype(np.int64)
+        return (2 * d.astype(np.int64) + ins_growth).sum(axis=0)
 
     def tick_fits(self, b: int, oracle, stream) -> bool:
         """Pre-apply probe for lane ``b``'s compiled tick stream: the
         lane's tracked run rows plus the stream's sound growth bound
         must stay inside the budget."""
-        growth = int(self._stream_growth(stream.del_len, stream.ins_len))
+        growth = int(self._stream_growth(stream.del_len, stream.ins_len,
+                                         stream.rows_per_step))
         return (int(self._lane_rows[b]) + growth <= self.row_budget
                 and self._orders_fit(oracle.get_next_order()))
 
@@ -242,7 +257,7 @@ class LanesMixedLaneBackend:
         self._state = res.state()
         self._pending = res
         self._lane_rows = self._lane_rows + self._stream_growth(
-            stacked.del_len, stacked.ins_len)
+            stacked.del_len, stacked.ins_len, stacked.rows_per_step)
 
     def _merge_rank_prefill(self, stacked: B.OpTensors) -> None:
         """Fold this tick's compile-known author ranks into the

@@ -56,7 +56,8 @@ class DocServer:
                               capacity=cfg.lane_capacity,
                               order_capacity=cfg.order_capacity,
                               lmax=cfg.lmax, block_k=cfg.lanes_block_k,
-                              interpret=cfg.interpret)
+                              interpret=cfg.interpret,
+                              fuse_w=cfg.fuse_w if cfg.fuse_steps else 1)
             for _ in range(cfg.num_shards)
         ]
         self.residency = LaneResidency(backends, self.router,
@@ -65,7 +66,9 @@ class DocServer:
         self.batcher = ContinuousBatcher(self.router, self.residency,
                                          step_buckets=cfg.step_buckets,
                                          lmax=cfg.lmax,
-                                         counters=self.counters)
+                                         counters=self.counters,
+                                         fuse_steps=cfg.fuse_steps,
+                                         fuse_w=cfg.fuse_w)
         self.tick_no = 0
 
     # -- traffic surface ----------------------------------------------------
@@ -141,11 +144,24 @@ class DocServer:
     def tick_summary(self) -> Dict[str, float]:
         """Serve tick wall-latency percentiles in milliseconds (one
         sample per ``tick()`` — the fixed-shape device pass plus the
-        host drain around it)."""
+        host drain around it), plus the generalized step-fusion
+        counters (ISSUE 6): how many compiled rows the per-doc tick
+        fusion eliminated (= bucket occupancy gained) and the
+        per-shape histogram."""
         ms = [s * 1e3 for s in self.batcher.tick_wall_samples]
         out = {k: round(v, 3)
                for k, v in percentiles(ms, (50, 99)).items()}
         out["samples"] = len(ms)
+        fs = self.batcher.fuse_stats
+        out["steps_total"] = fs.steps_out
+        out["steps_prefuse"] = fs.steps_in
+        out["fused_rows_saved"] = fs.rows_saved
+        # ops/step: compiled op rows landed per device step row (each
+        # pre-fusion row is one op's step).
+        out["ops_per_step"] = round(fs.reduction_x, 3)
+        for shape, n in fs.fused.items():
+            if n:
+                out[f"fuse_{shape}"] = n
         return out
 
     def stats(self) -> Dict[str, float]:
