@@ -1212,11 +1212,28 @@ def cfg_serve(args):
     engine = args.engine if args.engine in engines_for("serve") \
         else ServeConfig().engine
     docs, ticks, events = (24, 10, 16) if args.smoke else (200, 60, 48)
-    scfg = ServeConfig(engine=engine, num_shards=2, lanes_per_shard=16)
-    gen = ServeLoadGen(docs=docs, agents_per_doc=3, ticks=ticks,
-                       events_per_tick=events, zipf_alpha=1.1,
-                       fault_rate=0.10, local_prob=0.25, seed=7, cfg=scfg)
-    report = gen.run()
+
+    # ISSUE 7: the SAME seeded loadgen on both protocol generations —
+    # v1 (row frames per event, full-snapshot evictions) vs v2
+    # (windowed doc-multiplexed columnar frames, delta-chain
+    # evictions).  The primary row is the v2 run; the v1 run's byte
+    # counters ride along as the bytes-per-op comparison.
+    reports = {}
+    for wire, ckpt in (("row", "full"), ("columnar", "delta")):
+        scfg = ServeConfig(engine=engine, num_shards=2, lanes_per_shard=16,
+                           wire_format=wire, ckpt_format=ckpt)
+        gen = ServeLoadGen(docs=docs, agents_per_doc=3, ticks=ticks,
+                           events_per_tick=events, zipf_alpha=1.1,
+                           fault_rate=0.10, local_prob=0.25, seed=7,
+                           cfg=scfg)
+        reports[wire] = gen.run()
+    report = reports["columnar"]
+    row_wire = reports["row"]["wire"]
+    col_wire = report["wire"]
+    full_evict = reports["row"]["server"].get(
+        "ckpt_full_bytes_per_evict_mean", 0.0)
+    delta_evict = report["server"].get(
+        "ckpt_delta_bytes_per_evict_mean", 0.0)
     srv = report["server"]
     lanes = scfg.num_shards * scfg.lanes_per_shard
     hbm = scfg.num_shards * scfg.lanes_per_shard * (
@@ -1242,11 +1259,25 @@ def cfg_serve(args):
         steps_fused=report["tick_ms"].get("fused_rows_saved", 0),
         steps_prefuse=report["tick_ms"].get("steps_prefuse", 0),
         ops_per_step=report["tick_ms"].get("ops_per_step", 1.0),
+        wire_format=col_wire["format"],
+        ckpt_format=report["ckpt"]["format"],
+        wire_bytes_total=col_wire["txn_bytes"],
+        bytes_per_op=col_wire["bytes_per_op"],
+        bytes_per_op_row_wire=row_wire["bytes_per_op"],
+        wire_bytes_cut_x=round(
+            row_wire["bytes_per_op"] / max(col_wire["bytes_per_op"], 1e-9),
+            2),
+        ckpt_bytes_per_evict=delta_evict,
+        ckpt_bytes_per_evict_full=full_evict,
+        ckpt_evict_bytes_cut_x=round(
+            full_evict / max(delta_evict, 1e-9), 2) if delta_evict else 0.0,
+        row_wire_converged=reports["row"]["converged"],
         fault_rate=0.10, zipf_alpha=1.1,
         note="closed-loop serving: ops/s counts applied CRDT item-ops "
              "end-to-end through admission/causal-buffer/batch ticks, "
              "not raw kernel throughput; no equal-workload native "
-             "baseline is defined for the serving loop")
+             "baseline is defined for the serving loop; byte counters "
+             "compare the v2 wire/ckpt run against a same-seed v1 run")
 
 
 def cfg_serve_lanes(args):
@@ -1299,6 +1330,11 @@ def cfg_serve_lanes(args):
         p50_admission_to_applied_us=rep["latency_us"]["p50"],
         p99_admission_to_applied_us=rep["latency_us"]["p99"],
         evictions=rep["evictions"], restores=rep["restores"],
+        wire_format=(rep.get("wire") or {}).get("format"),
+        ckpt_format=(rep.get("ckpt") or {}).get("format"),
+        wire_bytes_total=(rep.get("wire") or {}).get("txn_bytes"),
+        bytes_per_op=(rep.get("wire") or {}).get("bytes_per_op"),
+        ckpt_bytes_per_evict=rep.get("ckpt_delta_bytes_per_evict"),
         note=out["note"])
 
 

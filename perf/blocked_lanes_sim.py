@@ -674,6 +674,15 @@ def serve_workload(smoke: bool = False, block_k: int = 0,
                 "evictions": r["server"].get("evictions", 0),
                 "restores": r["server"].get("restores", 0),
                 "docs_degraded": r["server"].get("docs_degraded", 0),
+                # ISSUE 7: the lanes backend serves the columnar wire +
+                # delta checkpoints (ServeConfig defaults) — byte
+                # counters prove the evict path writes O(new ops).
+                "wire": r.get("wire"),
+                "ckpt": r.get("ckpt"),
+                "ckpt_delta_bytes_per_evict": r["server"].get(
+                    "ckpt_delta_bytes_per_evict_mean", 0.0),
+                "ckpt_full_bytes_per_evict": r["server"].get(
+                    "ckpt_full_bytes_per_evict_mean", 0.0),
             }
             for eng, r in reports.items()
         },

@@ -1,0 +1,166 @@
+"""Replication-bytes + checkpoint-bytes probe (ISSUE 7 acceptance):
+the SAME seeded 200-doc serve loadgen run on both replication protocol
+generations —
+
+- **v1 (row/full)**: per-event row frames of <= 4 txns, each agent
+  re-shipping its merged export, one O(doc) full snapshot per evict
+  (the PR-1/PR-3 system exactly as it stood);
+- **v2 (columnar/delta)**: deduplicated per-world outboxes flushed
+  each resync window as doc-multiplexed columnar frames on one
+  connection (``net/columnar`` TXNS_MUX: per-column delta + RLE +
+  LEB128, whole-body DEFLATE), pull re-delivery as columnar streams,
+  and CRC-chained delta checkpoints writing O(ops since last save)
+  per evict —
+
+on both loadgen workloads (``scatter`` random edits, ``typing`` cursor
+runs — the real-editing-trace shape).  Every run must end with every
+doc bit-identical to its always-resident twin and every device lane
+bit-identical to its host oracle (the loadgen's built-in verifier —
+the PR-3/PR-4 safety net that makes the aggressive encoding change
+safe), the replicated op count must be IDENTICAL across protocol
+generations (traffic generation is protocol- and server-state-
+independent), and the acceptance bars are:
+
+- wire: v2 bytes-per-replicated-op >= 5x smaller than v1 on at least
+  one workload (recorded per workload);
+- checkpoints: the mean delta-link evict in the v2 run >= 5x smaller
+  than the mean full-snapshot evict in the v1 run, with the delta
+  scaling with ops-since-last-save, not doc size.
+
+Writes ``perf/columnar_wire_r10.json``.
+
+Run: python perf/columnar_wire_probe.py [--smoke] [--out PATH]
+"""
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except RuntimeError:
+    pass  # in-process import after backend init (the tier-1 smoke)
+
+from text_crdt_rust_tpu.config import ServeConfig  # noqa: E402
+from text_crdt_rust_tpu.serve.loadgen import ServeLoadGen  # noqa: E402
+
+GENERATIONS = (("row", "full"), ("columnar", "delta"))
+WORKLOADS = ("scatter", "typing")
+FAULT_RATES = (0.10, 0.0)   # the acceptance shape AND the clean
+#                             steady-state replication cost
+FLOOR_X = 5.0
+
+
+def run_one(workload: str, wire: str, ckpt: str, smoke: bool,
+            fault_rate: float = 0.10, seed: int = 7) -> dict:
+    docs, ticks, events = (24, 12, 16) if smoke else (200, 60, 48)
+    cfg = ServeConfig(engine="flat", num_shards=2, lanes_per_shard=16,
+                      wire_format=wire, ckpt_format=ckpt)
+    gen = ServeLoadGen(docs=docs, agents_per_doc=3, ticks=ticks,
+                       events_per_tick=events, zipf_alpha=1.1,
+                       fault_rate=fault_rate, local_prob=0.25, seed=seed,
+                       cfg=cfg, workload=workload)
+    t0 = time.perf_counter()
+    rep = gen.run()
+    assert rep["converged"], (workload, wire, rep["mismatches"][:4])
+    srv = rep["server"]
+    return {
+        "converged": rep["converged"],
+        "wall_s": round(time.perf_counter() - t0, 1),
+        "docs": docs, "ticks": ticks, "events_per_tick": events,
+        "wire": rep["wire"],
+        "ckpt": rep["ckpt"],
+        "evictions": srv.get("evictions", 0),
+        "restores": srv.get("restores", 0),
+        "ckpt_full_bytes_per_evict": round(srv.get(
+            "ckpt_full_bytes_per_evict_mean", 0.0), 1),
+        "ckpt_delta_bytes_per_evict": round(srv.get(
+            "ckpt_delta_bytes_per_evict_mean", 0.0), 1),
+        "ckpt_saves_full": srv.get("ckpt_saves_full", 0),
+        "ckpt_saves_delta": srv.get("ckpt_saves_delta", 0),
+        "item_ops_applied": rep["item_ops_applied"],
+    }
+
+
+def run_matrix(smoke: bool = False, seed: int = 7) -> dict:
+    out = {"seed": seed, "smoke": smoke, "cells": {}}
+    wire_cuts = {}
+    ckpt_cuts = {}
+    for workload in WORKLOADS:
+        for fault_rate in FAULT_RATES:
+            runs = {}
+            for wire, ckpt in GENERATIONS:
+                runs[wire] = run_one(workload, wire, ckpt, smoke,
+                                     fault_rate, seed)
+            v1, v2 = runs["row"], runs["columnar"]
+            assert (v1["wire"]["ops_replicated"]
+                    == v2["wire"]["ops_replicated"]), (
+                "traffic generation leaked protocol state")
+            wire_cut = (v1["wire"]["bytes_per_op"]
+                        / max(v2["wire"]["bytes_per_op"], 1e-9))
+            full_evict = v1["ckpt_full_bytes_per_evict"]
+            delta_evict = v2["ckpt_delta_bytes_per_evict"]
+            ckpt_cut = full_evict / max(delta_evict, 1e-9) \
+                if delta_evict else 0.0
+            cell = f"{workload}/faults={fault_rate}"
+            wire_cuts[cell] = round(wire_cut, 2)
+            ckpt_cuts[cell] = round(ckpt_cut, 2)
+            out["cells"][cell] = {
+                "runs": runs,
+                "bytes_per_op_row": v1["wire"]["bytes_per_op"],
+                "bytes_per_op_columnar": v2["wire"]["bytes_per_op"],
+                "wire_bytes_cut_x": round(wire_cut, 2),
+                "ckpt_full_bytes_per_evict": full_evict,
+                "ckpt_delta_bytes_per_evict": delta_evict,
+                "ckpt_evict_bytes_cut_x": round(ckpt_cut, 2),
+            }
+    out["claims"] = {
+        "floor_x": FLOOR_X,
+        "wire_bytes_cut_x": wire_cuts,
+        "wire_cut_headline_x": max(wire_cuts.values()),
+        "wire_cut_meets_floor": max(wire_cuts.values()) >= FLOOR_X,
+        "ckpt_evict_bytes_cut_x": ckpt_cuts,
+        "ckpt_cut_headline_x": max(ckpt_cuts.values()),
+        "ckpt_cut_meets_floor": min(
+            v for c, v in ckpt_cuts.items() if "0.1" in c) >= FLOOR_X,
+        "all_converged": True,  # run_one asserts per run
+    }
+    out["note"] = (
+        "CPU flat-backend runs (the serving loop is host+interpret "
+        "here; wire/ckpt bytes are backend-independent). bytes_per_op "
+        "= txn-lane bytes handed to the transport / deduplicated "
+        "replicated item-ops; control lane (DIGEST/REQUEST) counted "
+        "separately in each run's wire block. The v1 baseline is the "
+        "PR-1 protocol exactly as previously shipped. faults=0.0 is "
+        "the steady-state replication cost; faults=0.1 (drop + dup + "
+        "reorder + truncate + bit-flip EACH at 10% -> ~27% of frames "
+        "damaged) adds each protocol's recovery traffic on top — the "
+        "wire headline comes from the typing workload (the real-"
+        "editing-trace shape), the checkpoint floor must hold on the "
+        "faulted acceptance shape itself.")
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shape (tier-1 smoke); not committed")
+    ap.add_argument("--out", default="perf/columnar_wire_r10.json")
+    a = ap.parse_args(argv)
+    out = run_matrix(smoke=a.smoke)
+    if not a.smoke:
+        with open(a.out, "w") as f:
+            json.dump(out, f, indent=1)
+            f.write("\n")
+    print(json.dumps(out["claims"], indent=1))
+    ok = (out["claims"]["wire_cut_meets_floor"]
+          and out["claims"]["ckpt_cut_meets_floor"])
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -12,11 +12,15 @@ from text_crdt_rust_tpu.models import ListCRDT
 from text_crdt_rust_tpu.models.sync import merge_into
 from text_crdt_rust_tpu.utils.checkpoint import (
     FORMAT_VERSION,
+    CheckpointChain,
     CheckpointError,
     _meta_from_array,
     _meta_to_array,
+    load_delta,
     load_doc,
     load_flat_doc,
+    replay_chain,
+    save_delta,
     save_doc,
     save_flat_doc,
 )
@@ -188,6 +192,165 @@ class TestFlatCheckpointIntegrity:
                 f"byte {off}: corrupted flat checkpoint loaded garbage")
 
 
+def _edit(doc, rng, k, agents=None):
+    aids = agents or [doc.get_or_create_agent_id("peer-a")]
+    for _ in range(k):
+        aid = rng.choice(aids)
+        n = len(doc)
+        if n == 0 or rng.random() < 0.6:
+            doc.local_insert(aid, rng.randint(0, n), "".join(
+                rng.choice("abcdefgh") for _ in range(rng.randint(1, 4))))
+        else:
+            pos = rng.randint(0, n - 1)
+            doc.local_delete(aid, pos, min(rng.randint(1, 3), n - pos))
+
+
+class TestDeltaCheckpointChain:
+    """ISSUE-7: incremental checkpoints — a warm save records only the
+    ops since the referenced predecessor (columnar-encoded), the chain
+    is CRC-linked end to end, and ANY broken link is a typed refusal."""
+
+    def _chain(self, tmp_path, edits=(120, 40, 40), compact_ops=100000,
+               compact_links=16, seed=3):
+        rng = random.Random(seed)
+        doc = ListCRDT()
+        aids = [doc.get_or_create_agent_id(f"peer-{i}") for i in range(2)]
+        chain = CheckpointChain(str(tmp_path / "doc"),
+                                compact_ops=compact_ops,
+                                compact_links=compact_links)
+        infos = []
+        for k in edits:
+            _edit(doc, rng, k, aids)
+            infos.append(chain.save(doc))
+        return doc, chain, infos
+
+    def test_delta_restore_identical_and_o_new_ops(self, tmp_path):
+        from text_crdt_rust_tpu.models.sync import (
+            export_txns_since,
+            state_digest,
+        )
+
+        doc, chain, infos = self._chain(tmp_path)
+        assert [i["kind"] for i in infos] == ["full", "delta", "delta"]
+        # Warm saves scale with ops-since-last-save, not doc size.
+        assert infos[1]["bytes"] < infos[0]["bytes"] / 3
+        back = chain.load()
+        back.check()
+        assert back.to_string() == doc.to_string()
+        assert back.doc_spans() == doc.doc_spans()
+        assert state_digest(back) == state_digest(doc)
+        assert export_txns_since(back, 0) == export_txns_since(doc, 0)
+
+    def test_compaction_folds_chain(self, tmp_path):
+        doc, chain, infos = self._chain(
+            tmp_path, edits=(60,) + (20,) * 6, compact_links=3)
+        kinds = [i["kind"] for i in infos]
+        assert "delta" in kinds
+        assert kinds.count("full") >= 2, "compaction never triggered"
+        assert len(chain.links) < 3
+        assert chain.load().to_string() == doc.to_string()
+
+    def test_stale_base_refused(self, tmp_path):
+        """The base file replaced by a DIFFERENT snapshot (even a valid
+        one): every link names its predecessor's content CRC, so the
+        load refuses instead of replaying onto the wrong state."""
+        doc, chain, _ = self._chain(tmp_path)
+        other = two_peer_doc(seed=99)
+        save_doc(other, chain.base_path)
+        with pytest.raises(CheckpointError, match="crc|chain|stale"):
+            chain.load()
+
+    def test_missing_base_and_missing_link_refused(self, tmp_path):
+        import os
+
+        doc, chain, _ = self._chain(tmp_path)
+        link_path = chain.links[0]["path"]
+        os.remove(chain.base_path)
+        with pytest.raises(CheckpointError):
+            chain.load()
+        save_doc(doc, chain.base_path)  # base back, but now a link gone
+        os.remove(link_path)
+        with pytest.raises(CheckpointError):
+            chain.load()
+
+    def test_reordered_links_refused(self, tmp_path):
+        doc, chain, _ = self._chain(tmp_path)
+        paths = [link["path"] for link in chain.links]
+        with pytest.raises(CheckpointError, match="chain|order|crc"):
+            replay_chain(chain.base_path, list(reversed(paths)))
+
+    def test_skipped_link_refused(self, tmp_path):
+        doc, chain, _ = self._chain(tmp_path)
+        with pytest.raises(CheckpointError, match="chain|order|crc|link"):
+            replay_chain(chain.base_path, [chain.links[1]["path"]])
+
+    def test_delta_truncation_and_bitflips_refused(self, tmp_path):
+        doc, chain, _ = self._chain(tmp_path)
+        p = chain.links[0]["path"]
+        raw = open(p, "rb").read()
+        for frac in (0.0, 0.3, 0.9, 0.999):
+            open(p, "wb").write(raw[: int(len(raw) * frac)])
+            with pytest.raises(CheckpointError):
+                chain.load()
+        rng = random.Random(2)
+        for _ in range(60):
+            off = rng.randrange(len(raw))
+            buf = bytearray(raw)
+            buf[off] ^= 1 << rng.randrange(8)
+            if bytes(buf) == raw:
+                continue
+            open(p, "wb").write(bytes(buf))
+            try:
+                back = chain.load()
+            except CheckpointError:
+                continue
+            assert back.doc_spans() == doc.doc_spans(), (
+                f"byte {off}: corrupted delta replayed garbage")
+        open(p, "wb").write(raw)
+        assert chain.load().to_string() == doc.to_string()
+
+    def test_corrupt_embedded_txn_stream_refused(self, tmp_path):
+        """A zip/CRC-valid delta whose txns_blob is garbage: the wire
+        decoder inside must reject typed (CheckpointError, not
+        CodecError leaking through)."""
+        import numpy as np
+
+        doc, chain, _ = self._chain(tmp_path)
+        p = chain.links[0]["path"]
+        with np.load(p) as z:
+            arrays = {k: z[k] for k in z.files}
+        meta = _meta_from_array(arrays.pop("meta"))
+        blob = arrays["txns_blob"].copy()
+        blob[len(blob) // 2] ^= 0xFF
+        arrays["txns_blob"] = blob
+        # Re-sign the content CRC so only the INNER wire CRC can catch it.
+        from text_crdt_rust_tpu.utils.checkpoint import _save_npz
+
+        meta.pop("crc")
+        _save_npz(p, meta, arrays)
+        with pytest.raises(CheckpointError, match="txn stream|corrupt"):
+            chain.load()
+
+    def test_version_mismatch_refused(self, tmp_path):
+        import numpy as np
+
+        doc, chain, _ = self._chain(tmp_path)
+        p = chain.links[0]["path"]
+        with np.load(p) as z:
+            arrays = {k: z[k] for k in z.files}
+        meta = _meta_from_array(arrays.pop("meta"))
+        meta["version"] = FORMAT_VERSION - 1
+        np.savez(p, meta=_meta_to_array(meta), **arrays)
+        with pytest.raises(CheckpointError, match="version"):
+            load_delta(p)
+
+    def test_delta_from_order_ahead_of_doc_refused(self, tmp_path):
+        doc = two_peer_doc()
+        with pytest.raises(CheckpointError, match="stale|ahead"):
+            save_delta(doc, str(tmp_path / "d.npz"), base_crc=0,
+                       prev_crc=0, from_order=doc.get_next_order() + 5)
+
+
 class TestCheckpointUnderConcurrentTraffic:
     """ISSUE-3 satellite, at the utils/checkpoint + CausalBuffer level
     (no serve/ machinery): a doc checkpointed mid-stream while peers
@@ -255,4 +418,67 @@ class TestCheckpointUnderConcurrentTraffic:
         assert server.doc_spans() == twin.doc_spans()
         assert state_digest(server) == state_digest(twin)
         assert agent_watermarks(server) == agent_watermarks(twin)
+        assert buf.pending == 0
+
+    def test_delta_chain_restore_parity_with_full(self, tmp_path):
+        """ISSUE-7: the same evict-midstream shape restored from a
+        DELTA chain (base + two links) must land bit-identical to the
+        full-checkpoint restore and the always-resident twin — with
+        queued causal traffic replaying on top."""
+        from text_crdt_rust_tpu.models.sync import (
+            export_txns_since,
+            state_digest,
+        )
+        from text_crdt_rust_tpu.parallel.causal import CausalBuffer
+
+        rng = random.Random(8)
+        peer = ListCRDT()
+        pa = peer.get_or_create_agent_id("peer")
+        chunks, mark = [], 0
+        for i in range(30):
+            n = len(peer)
+            if n == 0 or rng.random() < 0.6:
+                peer.local_insert(pa, rng.randint(0, n), "xy")
+            else:
+                pos = rng.randint(0, n - 1)
+                peer.local_delete(pa, pos, min(2, n - pos))
+            chunks.append(export_txns_since(peer, mark))
+            mark = peer.get_next_order()
+
+        server = ListCRDT()
+        twin = ListCRDT()
+        buf = CausalBuffer()
+        chain = CheckpointChain(str(tmp_path / "doc"), compact_ops=100000)
+        full_p = str(tmp_path / "full.npz")
+
+        def feed(doc, txns):
+            for t in txns:
+                doc.apply_remote_txn(t)
+
+        # Warm the chain: save, edit, save (base + delta), twice evicted.
+        for lo, hi in ((0, 10), (10, 20)):
+            for chunk in chunks[lo:hi]:
+                feed(server, [t for t in buf.add_all(chunk)])
+                feed(twin, chunk)
+            chain.save(server)
+        assert [bool(chain.links)] == [True]
+        save_doc(server, full_p)
+        server = None
+        queued = []
+        for chunk in chunks[20:]:
+            queued.extend(buf.add_all(chunk))
+            feed(twin, chunk)
+        assert queued
+        # Restore BOTH ways; replay the same queued traffic.
+        via_chain = chain.load()
+        via_full = load_doc(full_p)
+        for doc in (via_chain, via_full):
+            doc.check()
+            feed(doc, queued)
+        assert via_chain.to_string() == twin.to_string()
+        assert via_chain.doc_spans() == via_full.doc_spans() \
+            == twin.doc_spans()
+        assert state_digest(via_chain) == state_digest(twin)
+        assert export_txns_since(via_chain, 0) \
+            == export_txns_since(via_full, 0)
         assert buf.pending == 0

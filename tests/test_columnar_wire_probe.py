@@ -1,0 +1,46 @@
+"""perf/columnar_wire_probe.py: the ISSUE-7 bytes-cut proof stays
+runnable (tier-1 smoke at a tiny shape) and the committed claims stay
+consistent with the checked-in JSON (slow tier re-reads the artifact).
+"""
+import json
+import os
+
+import pytest
+
+import perf.columnar_wire_probe as probe
+
+
+def test_probe_smoke_matrix_holds():
+    """The probe's small-scale path: every cell converges, the op
+    counts match across protocol generations, and the columnar wire
+    ships fewer txn bytes than the row wire in every cell."""
+    out = probe.run_matrix(smoke=True)
+    assert out["claims"]["all_converged"]
+    for cell, data in out["cells"].items():
+        assert data["bytes_per_op_columnar"] < data["bytes_per_op_row"], cell
+        v1 = data["runs"]["row"]
+        v2 = data["runs"]["columnar"]
+        assert v1["wire"]["ops_replicated"] == v2["wire"]["ops_replicated"]
+        # Delta checkpoints engage wherever re-evictions happened.
+        if v2["ckpt_saves_delta"]:
+            assert 0 < data["ckpt_delta_bytes_per_evict"] \
+                < data["ckpt_full_bytes_per_evict"]
+
+
+@pytest.mark.slow
+def test_committed_probe_claims():
+    """The checked-in perf/columnar_wire_r10.json meets the ISSUE-7
+    floors it claims (the acceptance bar, re-validated from the
+    artifact, not the code)."""
+    path = os.path.join(os.path.dirname(__file__), "..", "perf",
+                        "columnar_wire_r10.json")
+    with open(path) as f:
+        out = json.load(f)
+    claims = out["claims"]
+    assert claims["wire_cut_meets_floor"]
+    assert claims["wire_cut_headline_x"] >= claims["floor_x"]
+    assert claims["ckpt_cut_meets_floor"]
+    assert claims["all_converged"]
+    # The headline numbers trace back to real cells.
+    assert claims["wire_cut_headline_x"] in \
+        claims["wire_bytes_cut_x"].values()

@@ -1,9 +1,11 @@
 """Binary wire codec: round-trip fidelity and hard corruption rejection.
 
-The codec contract (ISSUE 1 acceptance): encode→decode round-trips
-arbitrary exported histories bit-identically, and EVERY single-byte
-corruption of a valid frame is rejected with ``CodecError`` — never an
-uncaught exception. Host-only (no JAX involved on this layer).
+The codec contract (ISSUE 1 acceptance, extended to the columnar v2
+frames by ISSUE 7): encode→decode round-trips arbitrary exported
+histories bit-identically — on BOTH wire formats, interchangeably — and
+EVERY single-byte corruption of a valid frame is rejected with
+``CodecError`` — never an uncaught exception. Host-only (no JAX
+involved on this layer).
 """
 import random
 
@@ -18,7 +20,7 @@ from text_crdt_rust_tpu.common import (
 )
 from text_crdt_rust_tpu.models.oracle import ListCRDT
 from text_crdt_rust_tpu.models.sync import export_txns_since, merge_into
-from text_crdt_rust_tpu.net import codec
+from text_crdt_rust_tpu.net import codec, columnar
 from text_crdt_rust_tpu.net.codec import (
     CodecError,
     decode_frame,
@@ -255,3 +257,253 @@ class TestStructuralValidation:
             validate_remote_txn(RemoteTxn(
                 RemoteId("a", 0), [],
                 [RemoteDel(RemoteId("b", 0), 0)]))
+
+
+class TestColumnarRoundTrip:
+    """The v2 per-column delta wire decodes to EXACTLY what the row
+    codec round-trips — the formats are interchangeable on the wire."""
+
+    def test_cross_format_200_seeded_docs(self):
+        """ISSUE-7 property fuzz: seeded batches round-trip byte-for-
+        byte equal after decode on both wire formats."""
+        for seed in range(200):
+            doc = seeded_doc(seed, steps=12, peers=1 + seed % 3)
+            txns = export_txns_since(doc, 0)
+            for frame in (encode_txns(txns), columnar.encode_txns(txns)):
+                kind, back, consumed = decode_frame(frame)
+                assert kind == codec.KIND_TXNS
+                assert consumed == len(frame)
+                assert back == txns, f"seed {seed} round-trip mismatch"
+
+    def test_mixed_format_frame_stream(self):
+        """Row and columnar frames interleave on one connection; the
+        version byte negotiates per frame."""
+        doc = seeded_doc(11, steps=20, peers=2)
+        txns = export_txns_since(doc, 0)
+        half = len(txns) // 2
+        stream = (encode_txns(txns[:half])
+                  + columnar.encode_txns(txns[half:]))
+        out = decode_frames(stream)
+        assert [k for k, _ in out] == [codec.KIND_TXNS] * 2
+        assert out[0][1] + out[1][1] == txns
+
+    def test_unicode_and_empty(self):
+        txns = [RemoteTxn(
+            RemoteId("ünïcode-agent", 0), [RemoteId("ROOT", 0xFFFFFFFF)],
+            [RemoteIns(RemoteId("ROOT", 0xFFFFFFFF),
+                       RemoteId("ROOT", 0xFFFFFFFF), "héllo 世界 🚀")],
+        )]
+        _, back, _ = decode_frame(columnar.encode_txns(txns))
+        assert back == txns
+        _, back, _ = decode_frame(columnar.encode_txns([]))
+        assert back == []
+
+    def test_stream_chunking(self):
+        doc = seeded_doc(5, steps=40, peers=3)
+        txns = export_txns_since(doc, 0)
+        stream = columnar.encode_txns_stream(txns, per_frame=7)
+        got = []
+        for kind, value in decode_frames(stream):
+            assert kind == codec.KIND_TXNS
+            got.extend(value)
+        assert got == txns
+
+    def test_mux_round_trip_and_chunking(self):
+        batches = []
+        for d in range(12):
+            doc = seeded_doc(100 + d, steps=10, peers=1 + d % 2)
+            batches.append((f"doc-{d}", export_txns_since(doc, 0)))
+        want = [(i, t) for i, (_, ts) in enumerate(batches) for t in ts]
+        frame = columnar.encode_mux(batches)
+        kind, groups, consumed = decode_frame(frame)
+        assert kind == codec.KIND_TXNS_MUX and consumed == len(frame)
+        flat = [(d, t) for d, ts in groups for t in ts]
+        assert flat == [(batches[i][0], t) for i, t in want]
+        # Chunked stream splits mid-doc; per-doc txn order must hold.
+        stream = columnar.encode_mux_stream(batches, per_frame=13)
+        got = []
+        for kind, groups in decode_frames(stream):
+            assert kind == codec.KIND_TXNS_MUX
+            got.extend((d, t) for d, ts in groups for t in ts)
+        assert got == flat
+        # Empty mux frame round-trips.
+        _, empty, _ = decode_frame(columnar.encode_mux([]))
+        assert empty == []
+
+    def test_deflated_body_round_trip(self):
+        """A frame big enough to win whole-body DEFLATE still decodes
+        bit-identically (flags bit 0 path)."""
+        doc = seeded_doc(3, steps=120, peers=3)
+        txns = export_txns_since(doc, 0)
+        frame = columnar.encode_txns(txns)
+        assert frame[2 + _varint_len(frame)] in (0, 1)
+        _, back, _ = decode_frame(frame)
+        assert back == txns
+
+
+def _varint_len(frame):
+    """Bytes the outer length varint occupies (frame[2:...])."""
+    n = 0
+    while frame[2 + n] & 0x80:
+        n += 1
+    return n + 1
+
+
+class TestColumnarCorruption:
+    """The PR-1 hard-rejection contract, bit for bit, on v2 frames."""
+
+    def _frame(self, seed=3, steps=4, peers=1):
+        doc = seeded_doc(seed, steps=steps, peers=peers)
+        return columnar.encode_txns(export_txns_since(doc, 0))
+
+    def _mux_frame(self):
+        batches = []
+        for d in range(3):
+            doc = seeded_doc(40 + d, steps=3, peers=1)
+            batches.append((f"doc-{d}", export_txns_since(doc, 0)))
+        return columnar.encode_mux(batches)
+
+    def test_every_single_byte_value_corruption_rejected(self):
+        """Exhaustive: every byte position × every wrong byte value on
+        a small single-doc columnar frame."""
+        frame = self._frame()
+        for i in range(len(frame)):
+            orig = frame[i]
+            for val in range(256):
+                if val == orig:
+                    continue
+                buf = bytearray(frame)
+                buf[i] = val
+                with pytest.raises(CodecError):
+                    decode_frame(bytes(buf))
+
+    def test_every_single_byte_value_corruption_rejected_mux(self):
+        frame = self._mux_frame()
+        rng = random.Random(0)
+        positions = set(range(24)) | {rng.randrange(len(frame))
+                                      for _ in range(40)}
+        for i in sorted(positions):
+            orig = frame[i]
+            for val in range(256):
+                if val == orig:
+                    continue
+                buf = bytearray(frame)
+                buf[i] = val
+                with pytest.raises(CodecError):
+                    decode_frame(bytes(buf))
+
+    def test_every_truncation_rejected_incl_mid_column_chunk(self):
+        """Every cut point — which sweeps truncation mid-column-chunk,
+        mid-name-table, and mid-CRC — must reject, on both the plain
+        and the deflated-body frame shapes."""
+        small = self._frame()
+        doc = seeded_doc(9, steps=120, peers=3)
+        big = columnar.encode_txns(export_txns_since(doc, 0))
+        for frame in (small, big, self._mux_frame()):
+            for cut in range(len(frame)):
+                with pytest.raises(CodecError):
+                    decode_frame(frame[:cut])
+
+    def test_flipped_version_byte_typed_never_misdecodes(self):
+        """A flipped version byte — with or without a fixed-up CRC — is
+        a typed error, never a silent mis-decode as the other format."""
+        for seed in range(50):
+            doc = seeded_doc(seed, steps=6, peers=1 + seed % 2)
+            txns = export_txns_since(doc, 0)
+            for frame, flip_to in ((encode_txns(txns), 2),
+                                   (columnar.encode_txns(txns), 1),
+                                   (columnar.encode_txns(txns), 3)):
+                buf = bytearray(frame)
+                buf[1] = flip_to
+                # CRC catches the bare flip...
+                with pytest.raises(CodecError):
+                    decode_frame(bytes(buf))
+                # ...and a CRC-fixed flip must still reject on body
+                # structure (or decode to the SAME txns, never others).
+                import struct
+                body = bytes(buf[:-4])
+                fixed = body + struct.pack("<I", codec.crc32c(body))
+                try:
+                    _, back, _ = decode_frame(fixed)
+                except CodecError:
+                    continue
+                assert back == txns, (
+                    f"seed {seed}: version flip {frame[1]}->{flip_to} "
+                    f"mis-decoded")
+
+    def test_structural_rejections(self):
+        # Unknown flags bits.
+        body = bytearray([codec.KIND_TXNS, 0x82])
+        with pytest.raises(CodecError, match="flags"):
+            decode_frame(codec._frame(bytes(body), version=2))
+        # Control frames are not defined for version 2.
+        with pytest.raises(CodecError, match="not defined"):
+            decode_frame(codec._frame(bytes([codec.KIND_REQUEST, 0]),
+                                      version=2))
+        # Mux kind is not defined for version 1.
+        with pytest.raises(CodecError, match="kind"):
+            decode_frame(codec._frame(bytes([codec.KIND_TXNS_MUX, 0])))
+        # DOC column is unknown in a single-doc body.
+        body = bytearray([codec.KIND_TXNS, 0])
+        codec._write_names(body, ["a"])
+        codec._write_varint(body, 0)      # zero txns
+        codec._write_varint(body, 1)      # one chunk
+        body.append(columnar.DOC << 1)    # doc column, raw
+        codec._write_varint(body, 0)
+        with pytest.raises(CodecError, match="column id"):
+            decode_frame(codec._frame(bytes(body), version=2))
+
+    def test_column_overrun_and_shortfall_rejected(self):
+        """Runs must land EXACTLY on the declared value count."""
+        def frame_with_tagruns(runs):
+            body = bytearray([codec.KIND_TXNS, 0])
+            codec._write_names(body, ["a"])
+            codec._write_varint(body, 1)      # one txn
+            codec._write_varint(body, 1)      # one chunk
+            chunk = bytearray()
+            for run_len, residual in runs:
+                codec._write_varint(chunk, run_len)
+                codec._write_varint(chunk, residual)
+            body.append(columnar.T_NOPS << 1)
+            codec._write_varint(body, len(chunk))
+            body += chunk
+            return codec._frame(bytes(body), version=2)
+
+        with pytest.raises(CodecError, match="overrun"):
+            decode_frame(frame_with_tagruns([(5, 0)]))   # 5 values for 1
+        with pytest.raises(CodecError, match="expected"):
+            decode_frame(frame_with_tagruns([]))         # 0 values... but
+        # absent chunk = all-zero prediction is fine — an EMPTY chunk is
+        # the shortfall case only when values were declared:
+        # (empty chunk body, expected 1 -> rejected above)
+
+    def test_adversarial_count_caps(self):
+        """A tiny CRC-valid frame declaring huge counts must hit the
+        allocation caps, not allocate."""
+        body = bytearray([codec.KIND_TXNS, 0])
+        codec._write_names(body, ["a"])
+        codec._write_varint(body, 1 << 40)    # absurd txn count
+        with pytest.raises(CodecError, match="cap"):
+            decode_frame(codec._frame(bytes(body), version=2))
+        # Huge op count via an RLE run (the row codec can bound counts
+        # by payload length; the columnar decoder needs explicit caps).
+        body = bytearray([codec.KIND_TXNS, 0])
+        codec._write_names(body, ["a"])
+        codec._write_varint(body, 1 << 16)    # txns at the cap exactly
+        codec._write_varint(body, 1)
+        chunk = bytearray()
+        codec._write_varint(chunk, 1 << 16)
+        codec._write_varint(chunk, 2 << 1)    # zigzag(+2): 3 ops per txn
+        body.append(columnar.T_NOPS << 1)
+        codec._write_varint(body, len(chunk))
+        body += chunk
+        with pytest.raises(CodecError, match="cap|exceed"):
+            decode_frame(codec._frame(bytes(body), version=2))
+
+    def test_surrogate_content_rejected_both_sides(self):
+        txn = RemoteTxn(
+            RemoteId("a", 0), [RemoteId("ROOT", 0xFFFFFFFF)],
+            [RemoteIns(RemoteId("ROOT", 0xFFFFFFFF),
+                       RemoteId("ROOT", 0xFFFFFFFF), "\ud800")])
+        with pytest.raises(CodecError, match="surrogate"):
+            columnar.encode_txns([txn])

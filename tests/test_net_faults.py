@@ -62,14 +62,18 @@ def converged(docs) -> bool:
 
 
 def pump_two_peer(seed: int, faults: FaultSpec = FAULTS,
-                  max_rounds: int = MAX_ROUNDS):
+                  max_rounds: int = MAX_ROUNDS,
+                  wires: tuple = ("row", "row")):
     """Run one seeded two-peer faulty sync to convergence; returns the
-    sessions + channels for metric assertions."""
+    sessions + channels for metric assertions. ``wires`` picks each
+    peer's TXNS encoding (decode negotiates on the version byte, so
+    mixed fleets interoperate)."""
     rng = random.Random(seed)
     da, db = ListCRDT(), ListCRDT()
     aa = da.get_or_create_agent_id(f"alice-{seed}")
     ab = db.get_or_create_agent_id(f"bob-{seed}")
-    sa, sb = ResyncSession(da), ResyncSession(db)
+    sa = ResyncSession(da, wire=wires[0])
+    sb = ResyncSession(db, wire=wires[1])
     ch_ab = FaultyChannel(faults, seed=seed * 2 + 1)
     ch_ba = FaultyChannel(faults, seed=seed * 2 + 2)
 
@@ -197,6 +201,26 @@ class TestTwoPeerFuzz:
         assert_oracle_convergence(sa, sb)
         assert sa.counters.get("frames_rejected") == 0
         assert sb.counters.get("frames_rejected") == 0
+
+    def test_mixed_wire_smoke_10_seeds(self):
+        """ISSUE-7 ride-along: one peer on the row wire, one on the
+        columnar wire — version negotiation makes a mixed fleet
+        converge through the same 10%-everything fault classes."""
+        for seed in range(10):
+            sa, sb, _, _ = pump_two_peer(seed, wires=("row", "columnar"))
+            assert_oracle_convergence(sa, sb)
+            assert sa.counters.get("wire_txn_bytes_sent") > 0
+            assert sb.counters.get("wire_txn_bytes_sent") > 0
+
+    @pytest.mark.slow
+    def test_mixed_wire_100_seeds(self):
+        """Deep mixed-wire sweep (both orientations), device engines
+        included."""
+        for seed in range(1000, 1050):
+            wires = ("row", "columnar") if seed % 2 else ("columnar", "row")
+            sa, sb, _, _ = pump_two_peer(seed, wires=wires)
+            assert_oracle_convergence(sa, sb)
+            assert_device_convergence(sa.doc)
 
 
 class TestNPeerFuzz:

@@ -44,6 +44,47 @@ def test_loadgen_clean_channel_seeds_differ():
     assert report["server"].get("rejected_frame_rejected", 0) == 0
 
 
+def test_wire_ckpt_matrix_small():
+    """ISSUE-7: row/full vs columnar/delta on the same seed — both
+    converge bit-identically to their twins, the byte counters are
+    exported, the replicated op count is identical (traffic is
+    protocol-independent), and the v2 wire ships fewer txn bytes."""
+    reports = {}
+    for wire, ckpt in (("row", "full"), ("columnar", "delta")):
+        cfg = ServeConfig(num_shards=1, lanes_per_shard=6,
+                          lane_capacity=256, order_capacity=512,
+                          wire_format=wire, ckpt_format=ckpt)
+        reports[wire] = run_and_check(
+            docs=16, agents_per_doc=3, ticks=16, events_per_tick=16,
+            zipf_alpha=1.1, fault_rate=0.10, seed=11, cfg=cfg)
+    row, col = reports["row"], reports["columnar"]
+    assert row["wire"]["format"] == "row"
+    assert col["wire"]["format"] == "columnar"
+    assert row["wire"]["ops_replicated"] == col["wire"]["ops_replicated"]
+    assert 0 < col["wire"]["txn_bytes"] < row["wire"]["txn_bytes"]
+    assert col["wire"]["bytes_per_op"] < row["wire"]["bytes_per_op"]
+    # Delta checkpoints: the first evict of a doc is a full base, warm
+    # re-evictions are deltas; both kinds must appear under this much
+    # lane pressure, and the byte counters must flow into the report.
+    assert row["ckpt"]["saves_full"] > 0 and row["ckpt"]["saves_delta"] == 0
+    assert col["ckpt"]["saves_delta"] > 0
+    assert col["ckpt"]["bytes_written"] > 0
+    assert "wire_bytes_in" in reports["columnar"]["tick_ms"] or \
+        "wire_bytes_in" in reports["columnar"]["server"]
+
+
+def test_typing_workload_converges():
+    """The typing workload (cursor runs — the real-editing shape) on
+    the columnar+delta path, twin-checked."""
+    cfg = ServeConfig(num_shards=1, lanes_per_shard=6, lane_capacity=384,
+                      order_capacity=768)
+    report = run_and_check(
+        docs=12, agents_per_doc=3, ticks=12, events_per_tick=12,
+        fault_rate=0.10, seed=5, cfg=cfg, workload="typing")
+    assert report["wire"]["workload"] == "typing"
+    assert report["wire"]["txn_bytes"] > 0
+
+
 @pytest.mark.slow
 def test_loadgen_acceptance_shape():
     """The ISSUE-3 acceptance criterion, verbatim: >=200 docs, >=3

@@ -218,6 +218,20 @@ class ServeConfig:
     #                            min(fuse_w, lanes_block_k // 2 - 1) on
     #                            backends with the W-row splice, 1 on
     #                            the rest (the one-split headroom rule)
+    wire_format: str = "columnar"  # TXNS frames the server EMITS
+    #                            (request serving): "row" = PR-1 frame
+    #                            version 1, "columnar" = the version-2
+    #                            per-column delta wire (net/columnar).
+    #                            Decode always negotiates on the version
+    #                            byte, so mixed-format peers interop.
+    ckpt_format: str = "delta"  # eviction checkpoints: "full" = one
+    #                            FORMAT_VERSION-3 oracle snapshot per
+    #                            evict (O(doc)); "delta" = CRC-chained
+    #                            incremental saves (O(ops since last
+    #                            save)) with periodic base compaction
+    ckpt_compact_ops: int = 4096   # delta chain: fold into a fresh base
+    #                            once ops-since-base exceed this
+    ckpt_compact_links: int = 16   # ... or the chain grows this long
 
     def add_args(self, ap: argparse.ArgumentParser) -> None:
         ap.add_argument("--serve-shards", type=int, default=self.num_shards)

@@ -41,12 +41,30 @@ from ..common import (
 from ..utils.integrity import crc32c
 
 MAGIC = 0xC7
-FRAME_VERSION = 1
+FRAME_VERSION = 1            # row-oriented body (this module)
+FRAME_VERSION_COLUMNAR = 2   # columnar body (net/columnar.py)
+_FRAME_VERSIONS = (FRAME_VERSION, FRAME_VERSION_COLUMNAR)
+
+# Wire-format names (ServeConfig.wire_format / session knobs) -> TXNS
+# encoder.  Decoding needs no selection: ``decode_frame`` negotiates on
+# the version byte, so peers on different formats interoperate.
+WIRE_FORMATS = ("row", "columnar")
+
+
+def txns_encoder(wire: str):
+    """The ``encode_txns`` implementation for a wire-format name."""
+    if wire == "row":
+        return encode_txns
+    if wire == "columnar":
+        from . import columnar
+        return columnar.encode_txns
+    raise ValueError(f"unknown wire format {wire!r}; one of {WIRE_FORMATS}")
 
 # Frame kinds (first payload byte).
-KIND_TXNS = 0     # batch of RemoteTxns
-KIND_REQUEST = 1  # per-agent "send me seqs >= from_seq" wants
-KIND_DIGEST = 2   # per-agent watermarks + portable state digest
+KIND_TXNS = 0      # batch of RemoteTxns
+KIND_REQUEST = 1   # per-agent "send me seqs >= from_seq" wants
+KIND_DIGEST = 2    # per-agent watermarks + portable state digest
+KIND_TXNS_MUX = 3  # v2 only: many docs' txn batches on one connection
 
 _MAX_PAYLOAD = 1 << 28   # 256 MiB: reject absurd declared lengths early
 _MAX_NAME_BYTES = 4096   # agent names are human-scale identifiers
@@ -191,16 +209,17 @@ def _read_rid(buf: bytes, cur: int, end: int,
 
 # -- framing -----------------------------------------------------------------
 
-def _frame(payload: bytes) -> bytes:
-    out = bytearray([MAGIC, FRAME_VERSION])
+def _frame(payload: bytes, version: int = FRAME_VERSION) -> bytes:
+    out = bytearray([MAGIC, version])
     _write_varint(out, len(payload))
     out += payload
     out += struct.pack("<I", crc32c(bytes(out)))
     return bytes(out)
 
 
-def _unframe(buf: bytes, offset: int) -> Tuple[bytes, int]:
-    """Validate one frame at ``offset``; return (payload, next_offset)."""
+def _unframe(buf: bytes, offset: int) -> Tuple[int, bytes, int]:
+    """Validate one frame at ``offset``; return
+    ``(version, payload, next_offset)``."""
     total = len(buf)
     if offset >= total:
         raise CodecError("empty input")
@@ -221,9 +240,9 @@ def _unframe(buf: bytes, offset: int) -> Tuple[bytes, int]:
             f"CRC mismatch: stored {stored:#010x} != computed {computed:#010x}")
     # Version is checked after the CRC: a corrupted version byte reports as
     # a CRC failure; a *valid* frame from a future format reports here.
-    if buf[offset + 1] != FRAME_VERSION:
+    if buf[offset + 1] not in _FRAME_VERSIONS:
         raise CodecError(f"unsupported frame version {buf[offset + 1]}")
-    return bytes(buf[cur:payload_end]), payload_end + 4
+    return buf[offset + 1], bytes(buf[cur:payload_end]), payload_end + 4
 
 
 # -- KIND_TXNS ---------------------------------------------------------------
@@ -355,11 +374,23 @@ def decode_frame(buf: bytes, offset: int = 0):
     (KIND_TXNS), a wants dict (KIND_REQUEST), or a ``(watermarks, digest)``
     pair (KIND_DIGEST). Raises ``CodecError`` on any malformed input.
     """
-    payload, next_offset = _unframe(buf, offset)
+    version, payload, next_offset = _unframe(buf, offset)
     if not payload:
         raise CodecError("empty payload")
     kind = payload[0]
     cur, end = 1, len(payload)
+    if version == FRAME_VERSION_COLUMNAR:
+        # Version 2 defines only the columnar TXNS bodies; control
+        # frames (REQUEST/DIGEST) stay version 1 — they are name maps
+        # with no columnar gear to gain.
+        from . import columnar
+        if kind == KIND_TXNS:
+            return KIND_TXNS, columnar.decode_txns(payload, cur, end), \
+                next_offset
+        if kind == KIND_TXNS_MUX:
+            return KIND_TXNS_MUX, \
+                columnar.decode_txns_mux(payload, cur, end), next_offset
+        raise CodecError(f"frame kind {kind} not defined for version 2")
     if kind == KIND_TXNS:
         return KIND_TXNS, _decode_txns(payload, cur, end), next_offset
     if kind == KIND_REQUEST:

@@ -252,8 +252,15 @@ class ResyncSession:
                  backoff_cap: int = 8,
                  digest_every: int = 1,
                  mirror: Optional[DeviceMirror] = None,
-                 counters: Optional[Counters] = None):
+                 counters: Optional[Counters] = None,
+                 wire: str = "row"):
         self.doc = doc
+        self.wire = wire
+        self._encode_txns = codec.txns_encoder(wire)
+        # The columnar wire amortizes its name table + column headers
+        # across the batch, so it ships far bigger frames; the row wire
+        # keeps the PR-1 loss-granularity default.
+        self._txns_per_frame = TXNS_PER_FRAME if wire == "row" else 512
         self.buffer = CausalBuffer(max_pending=max_pending)
         self.mirror = mirror
         self.counters = counters if counters is not None else Counters()
@@ -379,9 +386,11 @@ class ResyncSession:
         # New history (ours AND merged — peers beyond two hop through us).
         txns = export_txns_since(self.doc, self._bcast_order)
         self._bcast_order = self.doc.get_next_order()
-        for i in range(0, len(txns), TXNS_PER_FRAME):
-            frames.append(codec.encode_txns(txns[i:i + TXNS_PER_FRAME]))
+        for i in range(0, len(txns), self._txns_per_frame):
+            frame = self._encode_txns(txns[i:i + self._txns_per_frame])
+            frames.append(frame)
             self.counters.incr("frames_sent")
+            self.counters.incr("wire_txn_bytes_sent", len(frame))
 
         if self._tick % self.digest_every == 0:
             frames.append(codec.encode_digest(
@@ -419,9 +428,11 @@ class ResyncSession:
         if kind == codec.KIND_REQUEST:
             txns = export_txns_for_wants(self.doc, value)
             out: List[bytes] = []
-            for i in range(0, len(txns), TXNS_PER_FRAME):
-                out.append(codec.encode_txns(txns[i:i + TXNS_PER_FRAME]))
+            for i in range(0, len(txns), self._txns_per_frame):
+                frame = self._encode_txns(txns[i:i + self._txns_per_frame])
+                out.append(frame)
                 self.counters.incr("frames_sent")
+                self.counters.incr("wire_txn_bytes_sent", len(frame))
             self.counters.incr("requests_served")
             return out
 

@@ -106,6 +106,61 @@ def _locate_run(bo, bl, idx_k, r0, local):
     return i_r, o_r, l_r, off
 
 
+def fused_splice_rows(bo, bl, idx, p, i_r, o_r, l_r, off, il, st, w,
+                      wmax: int, shift, active=None):
+    """THE W-row fused-splice arithmetic, shared by every fused kernel
+    (``rle``/``rle_hbm`` via ``_insert_splice``; both ``rle_lanes`` and
+    both ``rle_lanes_mixed`` kernels call it directly with their lane
+    mask and shift primitive) — the PR-6 review debt: five drifting
+    copies of this block, now one.
+
+    ``w`` run rows of stride ``L = il // w`` land in ONE shift — row j
+    of the spliced window holds orders ``st + il - (j+1)*L`` (patch
+    order DESCENDS in document order: a same-position burst prepends
+    each patch before the previous one).  ``w == 1`` reduces to the
+    plain splice exactly (one row, order ``st``, length ``il``).  The
+    in-kernel append-merge stays w==1-only: a fused burst's first patch
+    merging would be un-done by its second patch's split at the same
+    boundary, so skipping it keeps the expanded state bit-identical to
+    the unfused stream (see the compile-side proof note).
+
+    ``idx`` is the caller's row-index plane, ``shift`` its row-shift
+    primitive (``_shift_rows`` for [K, 1] grids, the lanes kernels'
+    ``_vshift`` for [K, B] planes), ``wmax`` the static shift bound,
+    and ``active`` an optional lane mask (None = every lane active —
+    the single-doc kernels).  Returns ``(no, nl, amt, mrg, is_split,
+    lrun)``: new order/length planes, rows added, path flags, and the
+    fused stride (the mixed kernels' by-order table writes need it).
+    """
+    lrun = il // jnp.maximum(w, 1)
+    mrg = (w == 1) & (p > 0) & (off == l_r) & ((st + 1) == (o_r + l_r))
+    is_split = (p > 0) & (off < l_r)
+    if active is None:
+        dead = mrg
+    else:
+        mrg = active & mrg
+        is_split = active & is_split
+        dead = jnp.logical_not(active) | mrg
+    ins_at = jnp.where(p == 0, 0, i_r + 1)
+    amt = jnp.where(dead, 0, w + is_split.astype(jnp.int32))
+    so = shift(bo, amt, wmax + 1)
+    sl = shift(bl, amt, wmax + 1)
+    no = jnp.where(idx < ins_at, bo, so)
+    nl = jnp.where(idx < ins_at, bl, sl)
+    nl = jnp.where(is_split & (idx == i_r), off, nl)
+    new_run = (idx >= ins_at) & (idx < ins_at + w) & \
+        jnp.logical_not(mrg)
+    if active is not None:
+        new_run = active & new_run
+    no = jnp.where(new_run, st + il - (idx - ins_at + 1) * lrun + 1, no)
+    nl = jnp.where(new_run, lrun, nl)
+    tail = is_split & (idx == ins_at + w)
+    no = jnp.where(tail, o_r + off, no)
+    nl = jnp.where(tail, l_r - off, nl)
+    nl = jnp.where(mrg & (idx == i_r), l_r + il, nl)
+    return no, nl, amt, mrg, is_split, lrun
+
+
 def _insert_splice(bo, bl, idx_k, p, i_r, o_r, l_r, off, il, st,
                    w=None, wmax: int = 1):
     """In-register insert splice (`mutations.rs:17-179`): ≤3 touched rows
@@ -113,17 +168,8 @@ def _insert_splice(bo, bl, idx_k, p, i_r, o_r, l_r, off, il, st,
     the new block planes, rows added, and which path was taken.
 
     ``w``/``wmax`` extend the splice to FUSED multi-row steps
-    (``batch.compile_local_patches`` ``fuse_w``): ``w`` run rows of
-    stride ``L = il // w`` land in ONE shift — row j of the spliced
-    window holds orders ``st + il - (j+1)*L`` (patch order DESCENDS in
-    document order: a same-position burst prepends each patch before
-    the previous one).  ``w == 1`` reduces to the plain splice exactly
-    (one row, order ``st``, length ``il``).  The in-kernel append-merge
-    stays w==1-only: a fused burst's first patch merging would be
-    un-done by its second patch's split at the same boundary, so
-    skipping it keeps the expanded state bit-identical to the unfused
-    stream (see the compile-side proof note).  ``wmax`` is the static
-    shift bound (max w of the stream).
+    (``batch.compile_local_patches`` ``fuse_w``); the arithmetic lives
+    in ``fused_splice_rows`` (shared with the lanes kernels).
 
     The in-place merge path is device-state compaction only (an
     order-contiguous live extension of run ``i_r``); YjsSpan merge
@@ -131,24 +177,9 @@ def _insert_splice(bo, bl, idx_k, p, i_r, o_r, l_r, off, il, st,
     """
     if w is None:
         w = jnp.int32(1)
-    lrun = il // jnp.maximum(w, 1)
-    mrg = (w == 1) & (p > 0) & (off == l_r) & ((st + 1) == (o_r + l_r))
-    is_split = (p > 0) & (off < l_r)
-    ins_at = jnp.where(p == 0, 0, i_r + 1)
-    amt = jnp.where(mrg, 0, w + is_split.astype(jnp.int32))
-    so = _shift_rows(bo, amt, wmax + 1)
-    sl = _shift_rows(bl, amt, wmax + 1)
-    no = jnp.where(idx_k < ins_at, bo, so)
-    nl = jnp.where(idx_k < ins_at, bl, sl)
-    nl = jnp.where(is_split & (idx_k == i_r), off, nl)
-    new_run = (idx_k >= ins_at) & (idx_k < ins_at + w) & \
-        jnp.logical_not(mrg)
-    no = jnp.where(new_run, st + il - (idx_k - ins_at + 1) * lrun + 1, no)
-    nl = jnp.where(new_run, lrun, nl)
-    tail = is_split & (idx_k == ins_at + w)
-    no = jnp.where(tail, o_r + off, no)
-    nl = jnp.where(tail, l_r - off, nl)
-    nl = jnp.where(mrg & (idx_k == i_r), l_r + il, nl)
+    no, nl, amt, mrg, is_split, _lrun = fused_splice_rows(
+        bo, bl, idx_k, p, i_r, o_r, l_r, off, il, st, w, wmax,
+        _shift_rows)
     return no, nl, amt, mrg, is_split
 
 

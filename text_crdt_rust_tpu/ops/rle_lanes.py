@@ -51,6 +51,7 @@ from .batch import (
     prefill_logs,
 )
 from .blocked import _require
+from .rle import fused_splice_rows
 from .span_arrays import FlatDoc, I32, U32, make_flat_doc
 
 
@@ -244,10 +245,9 @@ def _rle_lanes_kernel(
 
         left = jnp.where(p == 0, root_u,
                          ((o_r - 1) + (off - 1)).astype(jnp.uint32))
-        lrun = il // jnp.maximum(w, 1)
-        mrg = active & (w == 1) & (p > 0) & (off == l_r) & \
-            ((st + 1) == (o_r + l_r))
-        is_split = active & (p > 0) & (off < l_r)
+        no, nl, amt, mrg, is_split, _lrun = fused_splice_rows(
+            bo, bl, idx, p, i_r, o_r, l_r, off, il, st, w, WMAX,
+            _vshift, active=active)
 
         nxt_in_blk = _vrow(bo, i_r + 1)
         first_o = _vrow(bo, 0)
@@ -257,24 +257,6 @@ def _rle_lanes_kernel(
                          jnp.where(is_split, o_r + off, succ_after))
         right = jnp.where(succ == 0, root_u,
                           (jnp.abs(succ) - 1).astype(jnp.uint32))
-
-        ins_at = jnp.where(p == 0, 0, i_r + 1)
-        amt = jnp.where(jnp.logical_not(active) | mrg, 0,
-                        w + is_split.astype(jnp.int32))
-        so = _vshift(bo, amt, WMAX + 1)
-        sl = _vshift(bl, amt, WMAX + 1)
-        no = jnp.where(idx < ins_at, bo, so)
-        nl = jnp.where(idx < ins_at, bl, sl)
-        nl = jnp.where(is_split & (idx == i_r), off, nl)
-        new_run = active & jnp.logical_not(mrg) & (idx >= ins_at) & \
-            (idx < ins_at + w)
-        no = jnp.where(new_run,
-                       st + il - (idx - ins_at + 1) * lrun + 1, no)
-        nl = jnp.where(new_run, lrun, nl)
-        tail = is_split & (idx == ins_at + w)
-        no = jnp.where(tail, o_r + off, no)
-        nl = jnp.where(tail, l_r - off, nl)
-        nl = jnp.where(mrg & (idx == i_r), l_r + il, nl)
         # Lanes with amt == 0 and no merge keep bo/bl exactly (masks are
         # all False there and _vshift(amt=0) is the identity).
         ordp[:] = no
@@ -646,10 +628,9 @@ def _lanes_blocked_kernel(
 
         left = jnp.where(p == 0, root_u,
                          ((o_r - 1) + (off - 1)).astype(jnp.uint32))
-        lrun = il // jnp.maximum(w, 1)
-        mrg = act & (w == 1) & (p > 0) & (off == l_r) & \
-            ((st + 1) == (o_r + l_r))
-        is_split = act & (p > 0) & (off < l_r)
+        no, nl, amt, mrg, is_split, _lrun = fused_splice_rows(
+            ws_o, ws_l, kdx, p, i_r, o_r, l_r, off, il, st, w, WMAX,
+            _vshift, active=act)
 
         # Raw successor (`doc.rs:452`): next row of this block, else the
         # head row of the NEXT logical slot's block.
@@ -664,24 +645,6 @@ def _lanes_blocked_kernel(
                          jnp.where(is_split, o_r + off, succ_after))
         right = jnp.where(succ == 0, root_u,
                           (jnp.abs(succ) - 1).astype(jnp.uint32))
-
-        ins_at = jnp.where(p == 0, 0, i_r + 1)
-        amt = jnp.where(jnp.logical_not(act) | mrg, 0,
-                        w + is_split.astype(jnp.int32))
-        so = _vshift(ws_o, amt, WMAX + 1)
-        sl = _vshift(ws_l, amt, WMAX + 1)
-        no = jnp.where(kdx < ins_at, ws_o, so)
-        nl = jnp.where(kdx < ins_at, ws_l, sl)
-        nl = jnp.where(is_split & (kdx == i_r), off, nl)
-        new_run = act & jnp.logical_not(mrg) & (kdx >= ins_at) & \
-            (kdx < ins_at + w)
-        no = jnp.where(new_run,
-                       st + il - (kdx - ins_at + 1) * lrun + 1, no)
-        nl = jnp.where(new_run, lrun, nl)
-        tail = is_split & (kdx == ins_at + w)
-        no = jnp.where(tail, o_r + off, no)
-        nl = jnp.where(tail, l_r - off, nl)
-        nl = jnp.where(mrg & (kdx == i_r), l_r + il, nl)
         scatter_block(ordp, b, no, act, K, NB)
         scatter_block(lenp, b, nl, act, K, NB)
         w_l = act & (tidx == l)

@@ -70,6 +70,7 @@ from .batch import (
     fused_width_checked,
 )
 from .blocked import _require
+from .rle import fused_splice_rows
 from .rle_lanes import (
     LanesResult,
     _lane_tile,
@@ -294,10 +295,9 @@ def _mixed_lanes_kernel(
         off = local - (_vrow(cum, i_r) - _vrow(lv, i_r))
 
         left = jnp.where(p == 0, root_i, (o_r - 1) + (off - 1))
-        lrun = il // jnp.maximum(w, 1)
-        mrg = act & (w == 1) & (p > 0) & (off == l_r) & \
-            ((st + 1) == (o_r + l_r))
-        is_split = act & (p > 0) & (off < l_r)
+        no, nl, amt, mrg, is_split, lrun = fused_splice_rows(
+            bo, bl, idx, p, i_r, o_r, l_r, off, il, st, w, WMAX,
+            _vshift, active=act)
 
         nxt_in_blk = _vrow(bo, i_r + 1)
         first_o = _vrow(bo, 0)
@@ -306,24 +306,6 @@ def _mixed_lanes_kernel(
         succ = jnp.where(p == 0, succ_p0,
                          jnp.where(is_split, o_r + off, succ_after))
         right = jnp.where(succ == 0, root_i, jnp.abs(succ) - 1)
-
-        ins_at = jnp.where(p == 0, 0, i_r + 1)
-        amt = jnp.where(jnp.logical_not(act) | mrg, 0,
-                        w + is_split.astype(jnp.int32))
-        so = _vshift(bo, amt, WMAX + 1)
-        sl = _vshift(bl, amt, WMAX + 1)
-        no = jnp.where(idx < ins_at, bo, so)
-        nl = jnp.where(idx < ins_at, bl, sl)
-        nl = jnp.where(is_split & (idx == i_r), off, nl)
-        new_run = act & jnp.logical_not(mrg) & (idx >= ins_at) & \
-            (idx < ins_at + w)
-        no = jnp.where(new_run,
-                       st + il - (idx - ins_at + 1) * lrun + 1, no)
-        nl = jnp.where(new_run, lrun, nl)
-        tail = is_split & (idx == ins_at + w)
-        no = jnp.where(tail, o_r + off, no)
-        nl = jnp.where(tail, l_r - off, nl)
-        nl = jnp.where(mrg & (idx == i_r), l_r + il, nl)
         ordp[:] = no
         lenp[:] = nl
         rowsv[:] = rows + amt
@@ -1185,10 +1167,9 @@ def _mixed_lanes_blocked_kernel(
         off = local - (_vrow(cum, i_r) - _vrow(lv, i_r))
 
         left = jnp.where(p == 0, root_i, (o_r - 1) + (off - 1))
-        lrun = il // jnp.maximum(w, 1)
-        mrg = act & (w == 1) & (p > 0) & (off == l_r) & \
-            ((st + 1) == (o_r + l_r))
-        is_split = act & (p > 0) & (off < l_r)
+        no, nl, amt, mrg, is_split, lrun = fused_splice_rows(
+            ws_o, ws_l, kdx, p, i_r, o_r, l_r, off, il, st, w, WMAX,
+            _vshift, active=act)
 
         nxt_in_blk = _vrow(ws_o, i_r + 1)
         b2 = trow(blkord, jnp.minimum(l + 1, NBT - 1))
@@ -1200,24 +1181,6 @@ def _mixed_lanes_blocked_kernel(
         succ = jnp.where(p == 0, succ_p0,
                          jnp.where(is_split, o_r + off, succ_after))
         right = jnp.where(succ == 0, root_i, jnp.abs(succ) - 1)
-
-        ins_at = jnp.where(p == 0, 0, i_r + 1)
-        amt = jnp.where(jnp.logical_not(act) | mrg, 0,
-                        w + is_split.astype(jnp.int32))
-        so = _vshift(ws_o, amt, WMAX + 1)
-        sl = _vshift(ws_l, amt, WMAX + 1)
-        no = jnp.where(kdx < ins_at, ws_o, so)
-        nl = jnp.where(kdx < ins_at, ws_l, sl)
-        nl = jnp.where(is_split & (kdx == i_r), off, nl)
-        new_run = act & jnp.logical_not(mrg) & (kdx >= ins_at) & \
-            (kdx < ins_at + w)
-        no = jnp.where(new_run,
-                       st + il - (kdx - ins_at + 1) * lrun + 1, no)
-        nl = jnp.where(new_run, lrun, nl)
-        tail = is_split & (kdx == ins_at + w)
-        no = jnp.where(tail, o_r + off, no)
-        nl = jnp.where(tail, l_r - off, nl)
-        nl = jnp.where(mrg & (kdx == i_r), l_r + il, nl)
         scatter_block(ordp, b, no, act, K, NB)
         scatter_block(lenp, b, nl, act, K, NB)
         w_l = act & (tidx == l)

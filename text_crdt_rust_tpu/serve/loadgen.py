@@ -31,13 +31,34 @@ from ..common import RemoteTxn, txn_len
 from ..config import ServeConfig
 from ..models.oracle import ListCRDT
 from ..models.sync import agent_watermarks, export_txns_since, state_digest
-from ..net import codec
+from ..net import codec, columnar
 from ..net.faults import FaultSpec, FaultyChannel
 from ..parallel.causal import CausalBuffer
 from .admission import AdmissionError
 from .server import DocServer
 
 TXNS_PER_FRAME = 4
+# Mux frames cap below the codec's 4096-txn limit: one frame is one
+# loss unit on the fault channel — a dropped whole-window frame turns
+# into a multi-doc backfill pull.
+MUX_TXNS_PER_FRAME = 1024
+# Nagle-style push policy (columnar wire): a doc's outbox ships once it
+# holds this many txns, or after this many flush rounds regardless.
+FLUSH_MIN_TXNS = 64
+FLUSH_MAX_AGE = 6
+# Pull chunking: a REQUEST want carries only a from-seq (the v1 control
+# frame), so the owed range is the WHOLE history suffix even when the
+# hole is one dropped frame. A faulty-phase pull ships a bounded chunk
+# per round — the causal buffer's watermark walks forward and the next
+# want narrows — instead of re-shipping the suffix every window. The
+# clean final drain ships unchunked, so recovery is never starved.
+PULL_CHUNK_TXNS = 48
+
+# The typing workload's deterministic vocabulary (real-text shape so
+# DEFLATE sees real-text statistics, not a uniform-random alphabet).
+WORDS = ("the quick brown fox jumps over a lazy dog while some text "
+         "gets typed into this doc one word at a time and then edited "
+         "again with small corrections near the cursor").split()
 
 
 class _DocWorld:
@@ -66,6 +87,15 @@ class _DocWorld:
         self.twin = ListCRDT()
         self.twin_buffer = CausalBuffer()
         self.server_mark = 0
+        # Columnar wire: fresh txns accumulate here between windowed
+        # flushes instead of shipping per event.  ``outbox_age`` counts
+        # flush rounds the outbox has waited (the Nagle-style policy:
+        # ship when big enough OR old enough — tiny per-doc batches are
+        # where column chains and DEFLATE can't win).
+        self.outbox: List[RemoteTxn] = []
+        self.outbox_age = 0
+        # Typing workload: per-agent cursor into the agent's replica.
+        self.cursor: Dict[str, int] = {a: 0 for a in agents}
 
     def record(self, txns: List[RemoteTxn]) -> List[RemoteTxn]:
         fresh = []
@@ -94,15 +124,36 @@ class _DocWorld:
                 seen.add(key)
                 doc.apply_remote_txn(t)
 
-    def agent_edit(self, rng: random.Random, agent: str,
-                   edits: int) -> List[RemoteTxn]:
+    def agent_edit(self, rng: random.Random, agent: str, edits: int,
+                   workload: str = "scatter") -> List[RemoteTxn]:
         """A burst of local edits on the agent's replica; returns the
-        NEW txns exported since the agent's last export mark."""
+        NEW txns exported since the agent's last export mark.
+
+        ``scatter`` (default, the PR-3 shape) edits uniform-random
+        positions; ``typing`` keeps a per-agent cursor and mostly types
+        forward word by word with occasional backspaces and cursor
+        jumps — the real-editing-trace shape (ROADMAP item 4), which
+        both the step fuser and the columnar wire's delta chains are
+        built for. Every position comes from the agent's OWN replica,
+        so traffic stays server-state-independent either way."""
         doc = self.replicas[agent]
         aid = self.replica_ids[agent]
         for _ in range(edits):
             n = len(doc)
-            if n == 0 or rng.random() < 0.55:
+            if workload == "typing":
+                cur = min(self.cursor[agent], n)
+                r = rng.random()
+                if n == 0 or r < 0.75:
+                    word = rng.choice(WORDS) + " "
+                    doc.local_insert(aid, cur, word)
+                    self.cursor[agent] = cur + len(word)
+                elif r < 0.87 and cur > 0:
+                    k = min(rng.randint(1, 4), cur)
+                    doc.local_delete(aid, cur - k, k)
+                    self.cursor[agent] = cur - k
+                else:
+                    self.cursor[agent] = rng.randint(0, n)
+            elif n == 0 or rng.random() < 0.55:
                 pos = rng.randint(0, n)
                 doc.local_insert(aid, pos, "".join(
                     rng.choice("abcdefgh") for _ in range(rng.randint(1, 4))))
@@ -122,7 +173,8 @@ class ServeLoadGen:
                  zipf_alpha: float = 1.1, fault_rate: float = 0.10,
                  local_prob: float = 0.25, seed: int = 7,
                  cfg: Optional[ServeConfig] = None,
-                 resync_every: int = 4, verbose: bool = False):
+                 resync_every: int = 4, verbose: bool = False,
+                 workload: str = "scatter"):
         self.rng = random.Random(seed)
         self.cfg = cfg or ServeConfig()
         self.server = DocServer(self.cfg)
@@ -131,6 +183,15 @@ class ServeLoadGen:
         self.local_prob = local_prob
         self.resync_every = max(1, resync_every)
         self.verbose = verbose
+        assert workload in ("scatter", "typing"), workload
+        self.workload = workload
+        # The replication protocol generation, from ServeConfig: "row" =
+        # the PR-1 shape (per-event frames of <= 4 txns, each agent
+        # re-shipping its merged export); "columnar" = the v2 shape
+        # (deduplicated per-world outboxes flushed each resync window as
+        # doc-multiplexed columnar frames on one connection, pull
+        # re-delivery as columnar streams).
+        self.wire = self.cfg.wire_format
         spec = FaultSpec.all(fault_rate)
         self.worlds: List[_DocWorld] = []
         for d in range(docs):
@@ -139,21 +200,42 @@ class ServeLoadGen:
             self.worlds.append(_DocWorld(doc_id, names,
                                          seed * 131 + d, spec))
             self.server.admit_doc(doc_id)
+        # The mux lane's own fault channel (one connection for the
+        # whole window flush; drops cost a window, anti-entropy pulls
+        # it back).
+        self.mux_channel = FaultyChannel(spec=spec, seed=seed * 7919 + 1)
         # Zipf popularity over docs (rank 0 hottest).
         self.weights = [1.0 / (i + 1) ** zipf_alpha for i in range(docs)]
         self.rejections = 0
         self.ops_offered = 0
+        # Wire accounting: bytes handed to the transport (pre-fault,
+        # the sender's cost) on the txn lane vs the control lane, and
+        # the deduplicated item-ops they carried.
+        self.wire_txn_bytes = 0
+        self.wire_push_bytes = 0   # event/flush lane
+        self.wire_pull_bytes = 0   # REQUEST-answer (backfill) lane
+        self.wire_ctrl_bytes = 0
+        self.ops_replicated = 0
 
     # -- traffic -------------------------------------------------------------
 
     def _ship(self, world: _DocWorld, agent: str,
-              txns: List[RemoteTxn], faulty: bool = True) -> None:
-        """Encode txns into frames and deliver them to the server,
-        optionally through the agent's fault channel."""
+              txns: List[RemoteTxn], faulty: bool = True,
+              lane: str = "push") -> None:
+        """Encode txns into ROW frames and deliver them to the server,
+        optionally through the agent's fault channel. (The v1 lane
+        only: all columnar traffic goes through ``_ship_mux``.)"""
+        assert self.wire == "row", "columnar traffic ships via _ship_mux"
         if not txns:
             return
         frames = [codec.encode_txns(txns[i:i + TXNS_PER_FRAME])
                   for i in range(0, len(txns), TXNS_PER_FRAME)]
+        nbytes = sum(len(f) for f in frames)
+        self.wire_txn_bytes += nbytes
+        if lane == "push":
+            self.wire_push_bytes += nbytes
+        else:
+            self.wire_pull_bytes += nbytes
         if faulty:
             ch = world.channels[agent]
             for f in frames:
@@ -162,6 +244,57 @@ class ServeLoadGen:
         for f in frames:
             try:
                 self.server.submit_frame(world.doc_id, f)
+            except AdmissionError:
+                self.rejections += 1
+
+    def _flush_mux(self, faulty: bool = True, final: bool = False) -> None:
+        """Columnar wire: ship deduplicated outboxes as doc-multiplexed
+        frames on one connection (each doc's batch agent-sorted — the
+        causal buffer re-orders on parents, and sorted columns are what
+        the delta chains predict well).
+
+        Nagle-style policy per doc: flush when the outbox reached
+        ``FLUSH_MIN_TXNS`` or waited ``FLUSH_MAX_AGE`` rounds (column
+        chains and frame DEFLATE only pay on batches; the anti-entropy
+        pull covers anything a deferral or a dropped frame delays)."""
+        batches: List[Tuple[str, List[RemoteTxn]]] = []
+        for world in self.worlds:
+            if not world.outbox:
+                continue
+            world.outbox_age += 1
+            if not (final or len(world.outbox) >= FLUSH_MIN_TXNS
+                    or world.outbox_age >= FLUSH_MAX_AGE):
+                continue
+            batches.append((world.doc_id,
+                            sorted(world.outbox,
+                                   key=lambda t: (t.id.agent, t.id.seq))))
+            world.outbox = []
+            world.outbox_age = 0
+        self._ship_mux(batches, faulty=faulty)
+
+    def _ship_mux(self, batches: List[Tuple[str, List[RemoteTxn]]],
+                  faulty: bool = True, lane: str = "push") -> None:
+        flat: List[Tuple[str, RemoteTxn]] = [
+            (doc_id, t) for doc_id, txns in batches for t in txns]
+        if not flat:
+            return
+        frames: List[bytes] = []
+        for i in range(0, len(flat), MUX_TXNS_PER_FRAME):
+            frames.append(columnar.encode_mux(
+                columnar.group_consecutive(flat[i:i + MUX_TXNS_PER_FRAME])))
+        nbytes = sum(len(f) for f in frames)
+        self.wire_txn_bytes += nbytes
+        if lane == "push":
+            self.wire_push_bytes += nbytes
+        else:
+            self.wire_pull_bytes += nbytes
+        if faulty:
+            for f in frames:
+                self.mux_channel.send(f)
+            frames = self.mux_channel.drain()
+        for f in frames:
+            try:
+                self.rejections += len(self.server.submit_mux_frame(f))
             except AdmissionError:
                 self.rejections += 1
 
@@ -175,6 +308,7 @@ class ServeLoadGen:
                 replica = world.replicas[agent]
                 frame = codec.encode_digest(agent_watermarks(replica),
                                             state_digest(replica))
+                self.wire_ctrl_bytes += len(frame)
                 if faulty:
                     ch = world.channels[agent]
                     ch.send(frame)
@@ -191,19 +325,42 @@ class ServeLoadGen:
         """Answer the server's owed REQUEST frames from the generation
         log; returns how many docs still had wants."""
         wanting = 0
+        owed_batches: List[Tuple[str, List[RemoteTxn]]] = []
         for world in self.worlds:
             req = self.server.poll_request_frame(world.doc_id)
             if req is None:
                 continue
             wanting += 1
+            self.wire_ctrl_bytes += len(req)
             kind, wants, _ = codec.decode_frame(req)
             assert kind == codec.KIND_REQUEST
             owed = [t for t in world.txns
                     if t.id.agent in wants
                     and t.id.seq + txn_len(t) > wants[t.id.agent]]
-            # Deliver via the hottest agent's channel (any path works;
-            # the server dedups) — clean in the final drain.
-            self._ship(world, world.agents[0], owed, faulty=faulty)
+            if self.wire == "columnar":
+                # A want that names txns still sitting in the world's
+                # outbox is the push deferral showing through the
+                # digest gossip, not a loss — the scheduled flush
+                # delivers them. Pulling them too would double-ship
+                # every deferred window.
+                deferred = {(t.id.agent, t.id.seq) for t in world.outbox}
+                owed = [t for t in owed
+                        if (t.id.agent, t.id.seq) not in deferred]
+                if faulty:
+                    owed = owed[:PULL_CHUNK_TXNS]
+            if self.wire == "columnar":
+                # The pull lane is a backfill: ship ALL docs' owed
+                # ranges as one multiplexed columnar stream — per-doc
+                # frames would hand the overhead right back.
+                if owed:
+                    owed_batches.append((world.doc_id, sorted(
+                        owed, key=lambda t: (t.id.agent, t.id.seq))))
+            else:
+                # Deliver via the hottest agent's channel (any path
+                # works; the server dedups) — clean in the final drain.
+                self._ship(world, world.agents[0], owed, faulty=faulty,
+                           lane="pull")
+        self._ship_mux(owed_batches, faulty=faulty, lane="pull")
         return wanting
 
     def _observe_server_edits(self) -> None:
@@ -249,12 +406,24 @@ class ServeLoadGen:
                 agent = self.rng.choice(world.agents)
                 world.gossip(self.rng, agent)
                 txns = world.agent_edit(self.rng, agent,
-                                        self.rng.randint(1, 3))
+                                        self.rng.randint(1, 3),
+                                        workload=self.workload)
                 fresh = world.record(txns)
                 world.feed_twin(fresh)
-                self.ops_offered += sum(txn_len(t) for t in fresh)
-                self._ship(world, agent, txns, faulty=True)
+                ops = sum(txn_len(t) for t in fresh)
+                self.ops_offered += ops
+                self.ops_replicated += ops
+                if self.wire == "columnar":
+                    # v2 protocol: dedup into the world's outbox; the
+                    # windowed mux flush ships it (re-shipping every
+                    # agent's merged export per event is most of the v1
+                    # byte bill).
+                    world.outbox.extend(fresh)
+                else:
+                    self._ship(world, agent, txns, faulty=True)
         if (tick_index + 1) % self.resync_every == 0:
+            if self.wire == "columnar":
+                self._flush_mux(faulty=True)
             self._gossip_digests(faulty=True)
             self._resync(faulty=True)
         # Server-authored history reaches the twins in the final
@@ -285,6 +454,8 @@ class ServeLoadGen:
         # no REQUESTs and every queue is empty — the anti-entropy cycle
         # that recovers everything the fault channels mangled.
         drain_rounds = 0
+        if self.wire == "columnar":
+            self._flush_mux(faulty=False, final=True)
         self._gossip_digests(faulty=False)
         for drain_rounds in range(1, 64):
             wanting = self._resync(faulty=False)
@@ -311,6 +482,24 @@ class ServeLoadGen:
             "latency_us": self.server.latency_summary(),
             "tick_ms": self.server.tick_summary(),
             "engine": self.cfg.engine,
+            "wire": {
+                "format": self.wire,
+                "workload": self.workload,
+                "txn_bytes": self.wire_txn_bytes,
+                "push_bytes": self.wire_push_bytes,
+                "pull_bytes": self.wire_pull_bytes,
+                "ctrl_bytes": self.wire_ctrl_bytes,
+                "ops_replicated": self.ops_replicated,
+                "bytes_per_op": round(
+                    self.wire_txn_bytes / max(1, self.ops_replicated), 3),
+            },
+            "ckpt": {
+                "format": self.cfg.ckpt_format,
+                "bytes_written": stats.get("ckpt_bytes_written", 0),
+                "saves_full": stats.get("ckpt_saves_full", 0),
+                "saves_delta": stats.get("ckpt_saves_delta", 0),
+                "bytes_per_evict": stats.get("ckpt_bytes_per_evict_mean", 0),
+            },
             "server": stats,
         }
         return report
@@ -367,6 +556,20 @@ def main(argv=None) -> None:
                     help="run on the default jax backend (TPU when the "
                          "tunnel is up) instead of forcing CPU — the "
                          "perf/when_up_r7.sh on-silicon serve smoke")
+    d = ServeConfig()
+    ap.add_argument("--wire", default=d.wire_format,
+                    choices=("row", "columnar"),
+                    help="replication protocol generation: per-event "
+                         "row frames (v1) or windowed doc-multiplexed "
+                         "columnar frames (v2)")
+    ap.add_argument("--ckpt", default=d.ckpt_format,
+                    choices=("full", "delta"),
+                    help="eviction checkpoints: full O(doc) snapshots "
+                         "or CRC-chained O(new ops) deltas")
+    ap.add_argument("--workload", default="scatter",
+                    choices=("scatter", "typing"),
+                    help="agent edit shape: uniform-random positions "
+                         "or cursor-based typing runs")
     ap.add_argument("--verbose", action="store_true")
     a = ap.parse_args(argv)
 
@@ -375,11 +578,13 @@ def main(argv=None) -> None:
     if not a.device:
         jax.config.update("jax_platforms", "cpu")
     cfg = ServeConfig(engine=a.engine, num_shards=a.shards,
-                      lanes_per_shard=a.lanes)
+                      lanes_per_shard=a.lanes,
+                      wire_format=a.wire, ckpt_format=a.ckpt)
     gen = ServeLoadGen(docs=a.docs, agents_per_doc=a.agents, ticks=a.ticks,
                        events_per_tick=a.events_per_tick, zipf_alpha=a.zipf,
                        fault_rate=a.fault_rate, local_prob=a.local_prob,
-                       seed=a.seed, cfg=cfg, verbose=a.verbose)
+                       seed=a.seed, cfg=cfg, verbose=a.verbose,
+                       workload=a.workload)
     report = gen.run()
     import json
 

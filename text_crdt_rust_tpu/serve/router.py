@@ -44,7 +44,7 @@ from ..net.codec import CodecError
 from ..ops import batch as B
 from ..parallel.causal import CausalBuffer
 from ..utils.metrics import Counters
-from .admission import AdmissionControl
+from .admission import AdmissionControl, AdmissionError
 
 # Event kinds in a doc's FIFO queue.
 EV_TXN = "txn"      # payload: a causally-ready RemoteTxn
@@ -140,12 +140,20 @@ class ShardRouter:
 
     def __init__(self, num_shards: int, *, admission: AdmissionControl,
                  counters: Optional[Counters] = None,
-                 buffer_max_pending: Optional[int] = 512):
+                 buffer_max_pending: Optional[int] = 512,
+                 wire_format: str = "row"):
         assert num_shards >= 1
         self.num_shards = num_shards
         self.admission = admission
         self.counters = counters if counters is not None else Counters()
         self.buffer_max_pending = buffer_max_pending
+        # TXNS frames the router EMITS (serving REQUEST pulls); decode
+        # always negotiates on the version byte, so what peers send is
+        # their choice. The columnar wire amortizes per-frame overhead
+        # across much bigger batches.
+        self.wire_format = wire_format
+        self._encode_txns = codec.txns_encoder(wire_format)
+        self._txns_per_frame = 8 if wire_format == "row" else 512
         self.docs: Dict[str, DocState] = {}
         self._shard_docs = [0] * num_shards
         self._tick = 0
@@ -257,6 +265,7 @@ class ShardRouter:
         (served REQUESTs). Corrupt bytes raise a typed, counted
         ``AdmissionError`` — never an uncaught decode error."""
         doc = self.doc(doc_id)
+        self.counters.incr("wire_bytes_in", len(data))
         try:
             kind, value, _ = codec.decode_frame(data)
         except CodecError as e:
@@ -286,10 +295,21 @@ class ShardRouter:
                 return []
             txns = export_txns_for_wants(doc.oracle, value)
             out = []
-            for i in range(0, len(txns), 8):
-                out.append(codec.encode_txns(txns[i:i + 8]))
+            for i in range(0, len(txns), self._txns_per_frame):
+                frame = self._encode_txns(txns[i:i + self._txns_per_frame])
+                out.append(frame)
+                self.counters.incr("wire_txn_bytes_out", len(frame))
             self.counters.incr("requests_served")
             return out
+
+        if kind == codec.KIND_TXNS_MUX:
+            # A multiplexed frame on the per-doc lane would need doc
+            # routing this entry point does not do — a silent drop here
+            # would ack-then-lose the txns. Typed refusal, like every
+            # other wrong-shape input.
+            raise self.admission.reject_frame(
+                "TXNS_MUX frame on the per-doc lane "
+                "(use submit_mux_frame)")
 
         # KIND_DIGEST: watermark gossip (reveals agents whose frames were
         # ALL lost — the causal buffer alone can't see those gaps; the
@@ -306,6 +326,40 @@ class ShardRouter:
                 doc.divergence_detected = True
                 self.counters.incr("divergence_detected")
         return []
+
+    def submit_mux_frame(self, data: bytes) -> List[Tuple[str, str]]:
+        """Ingest one doc-multiplexed TXNS frame (``net/columnar``
+        TXNS_MUX) — the connection-level replication lane, where one
+        frame carries many documents' batches.
+
+        Admission is all-or-nothing per DOC GROUP, not per frame: one
+        overloaded or unknown document must not reject every other
+        document sharing the connection. Returns the per-group
+        rejections as ``(doc_id, reason)`` pairs (the frame itself
+        failing to decode still raises, as in ``submit_frame``)."""
+        self.counters.incr("wire_bytes_in", len(data))
+        try:
+            kind, groups, _ = codec.decode_frame(data)
+        except CodecError as e:
+            raise self.admission.reject_frame(str(e)) from None
+        if kind != codec.KIND_TXNS_MUX:
+            raise self.admission.reject_frame(
+                f"frame kind {kind} on the mux lane")
+        self.counters.incr("frames_received")
+        rejected: List[Tuple[str, str]] = []
+        for doc_id, txns in groups:
+            try:
+                doc = self.doc(doc_id)
+                for i, txn in enumerate(txns):
+                    self.admission.check(doc_id, txn.id.agent, txn_len(txn),
+                                         doc.pending() + i, self._tick)
+            except AdmissionError as e:
+                rejected.append((doc_id, str(e)))
+                continue
+            for txn in txns:
+                self.admission.count_admitted(txn_len(txn))
+                self._ingest_txn(doc, txn)
+        return rejected
 
     # -- pull / export surface ---------------------------------------------
 

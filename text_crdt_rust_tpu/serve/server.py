@@ -50,7 +50,8 @@ class DocServer:
             rate_refill=cfg.rate_refill,
             counters=self.counters)
         self.router = ShardRouter(cfg.num_shards, admission=self.admission,
-                                  counters=self.counters)
+                                  counters=self.counters,
+                                  wire_format=cfg.wire_format)
         backends = [
             make_lane_backend(cfg.engine, lanes=cfg.lanes_per_shard,
                               capacity=cfg.lane_capacity,
@@ -62,7 +63,10 @@ class DocServer:
         ]
         self.residency = LaneResidency(backends, self.router,
                                        spool_dir=cfg.spool_dir,
-                                       counters=self.counters)
+                                       counters=self.counters,
+                                       ckpt_format=cfg.ckpt_format,
+                                       ckpt_compact_ops=cfg.ckpt_compact_ops,
+                                       ckpt_compact_links=cfg.ckpt_compact_links)
         self.batcher = ContinuousBatcher(self.router, self.residency,
                                          step_buckets=cfg.step_buckets,
                                          lmax=cfg.lmax,
@@ -78,6 +82,11 @@ class DocServer:
 
     def submit_frame(self, doc_id: str, data: bytes) -> List[bytes]:
         return self.router.submit_frame(doc_id, data)
+
+    def submit_mux_frame(self, data: bytes):
+        """One doc-multiplexed TXNS frame (the connection-level
+        replication lane); returns per-doc-group rejections."""
+        return self.router.submit_mux_frame(data)
 
     def submit_txn(self, doc_id: str, txn: RemoteTxn) -> None:
         self.router.submit_txn(doc_id, txn)
@@ -162,6 +171,14 @@ class DocServer:
         for shape, n in fs.fused.items():
             if n:
                 out[f"fuse_{shape}"] = n
+        # Bytes-on-wire + checkpoint-bytes (ISSUE 7): what the columnar
+        # wire and delta checkpoints are cutting, by lane.
+        c = self.counters.summary()
+        for key in ("wire_bytes_in", "wire_txn_bytes_out",
+                    "ckpt_bytes_written", "ckpt_saves_full",
+                    "ckpt_saves_delta", "ckpt_bytes_per_evict_mean"):
+            if key in c:
+                out[key] = c[key]
         return out
 
     def stats(self) -> Dict[str, float]:

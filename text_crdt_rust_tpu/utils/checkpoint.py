@@ -23,13 +23,29 @@ CRC32 over its array contents plus a format version, and loads REFUSE
 corrupted, truncated, or version-mismatched files with a typed
 ``CheckpointError`` — a resume must restore bit-identical state or fail
 precisely, never load garbage into a serving replica.
+
+Incremental (delta) checkpoints (ISSUE 7): a ``kind="delta"`` file
+records the history since a referenced predecessor — the ops exported
+by ``models.sync.export_txns_since`` from the predecessor's
+``next_order``, encoded through the columnar wire format
+(``net/columnar``) — so a warm save costs O(ops since last save)
+instead of O(doc).  Chain integrity mirrors the wire's hard-rejection
+contract: each delta names its predecessor's content CRC
+(``prev_crc``) and order interval; a load walks base → deltas
+verifying every link and REFUSES a stale, missing, or mismatched base
+with a typed error.  Restore = load base + replay the decoded txns —
+replay assigns the same orders in the same sequence the live document
+did, so a chain restore is bit-identical to a full-snapshot restore
+(``tests/test_checkpoint_integrity.py`` pins it).  ``CheckpointChain``
+manages one document's base + links with periodic compaction.
 """
 from __future__ import annotations
 
 import json
+import os
 import zipfile
 import zlib
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -43,9 +59,10 @@ from .rle import (
     TxnSpan,
 )
 
-# v2: adds the content CRC32 (zlib) to the meta header (v1 files predate
-# integrity checking and are refused — re-save from a live document).
-FORMAT_VERSION = 2
+# v2 added the content CRC32 (zlib) to the meta header; v3 adds the
+# ``next_order`` meta (the delta-chain anchor) and the ``delta`` kind.
+# Older versions are refused — re-save from a live document.
+FORMAT_VERSION = 3
 
 
 class CheckpointError(Exception):
@@ -74,10 +91,13 @@ def _content_crc(arrays: Dict[str, np.ndarray]) -> int:
     return crc & 0xFFFF_FFFF
 
 
-def _save_npz(path: str, meta: dict, arrays: Dict[str, np.ndarray]) -> None:
+def _save_npz(path: str, meta: dict, arrays: Dict[str, np.ndarray]) -> int:
+    """Write one checkpoint member file; returns its content CRC (the
+    chain-link identity delta checkpoints reference)."""
     meta = dict(meta)
-    meta["crc"] = _content_crc(arrays)
+    crc = meta["crc"] = _content_crc(arrays)
     np.savez(path, meta=_meta_to_array(meta), **arrays)
+    return crc
 
 
 def _load_npz(path: str, expect_kind: str):
@@ -91,7 +111,10 @@ def _load_npz(path: str, expect_kind: str):
         with np.load(path) as z:
             arrays = {k: z[k] for k in z.files}
     except (OSError, EOFError, ValueError, KeyError,
-            zipfile.BadZipFile) as e:
+            NotImplementedError, zipfile.BadZipFile) as e:
+        # NotImplementedError: zipfile refuses exotic flag bits a
+        # corrupting flip can set (e.g. "compressed patched data") —
+        # still a corrupt file, still a typed refusal.
         raise CheckpointError(f"unreadable checkpoint {path!r}: {e}") from e
     if "meta" not in arrays:
         raise CheckpointError(f"checkpoint {path!r} has no meta header")
@@ -119,8 +142,11 @@ def _load_npz(path: str, expect_kind: str):
     return meta, arrays
 
 
-def save_doc(doc, path: str) -> None:
-    """Serialize an oracle ``ListCRDT`` to ``path`` (.npz)."""
+def save_doc(doc, path: str) -> dict:
+    """Serialize an oracle ``ListCRDT`` to ``path`` (.npz).
+
+    Returns ``{"crc", "next_order", "bytes"}`` — what a delta chain
+    needs to reference this file as its base."""
     n = doc.n
     cwo = list(doc.client_with_order)
     deletes = list(doc.deletes)
@@ -139,6 +165,7 @@ def save_doc(doc, path: str) -> None:
         "kind": "oracle",
         "agents": [cd.name for cd in doc.client_data],
         "n": n,
+        "next_order": doc.get_next_order(),
     }
     arrays = dict(
         order=doc.order[:n],
@@ -160,7 +187,9 @@ def save_doc(doc, path: str) -> None:
                         dtype=np.int64).reshape(-1, 3),
         txn_parents=np.asarray(parents, dtype=np.int64).reshape(-1, 2),
     )
-    _save_npz(path, meta, arrays)
+    crc = _save_npz(path, meta, arrays)
+    return {"crc": crc, "next_order": meta["next_order"],
+            "bytes": os.path.getsize(path)}
 
 
 def load_doc(path: str):
@@ -169,6 +198,13 @@ def load_doc(path: str):
     Raises ``CheckpointError`` if the file is corrupted, truncated, or a
     different format version — never returns partial state.
     """
+    return _load_doc_with_meta(path)[0]
+
+
+def _load_doc_with_meta(path: str):
+    """``(doc, meta)`` from one validated read — chain restores need the
+    base's CRC/next_order without re-reading and re-checksumming the
+    whole O(doc) file."""
     meta, z = _load_npz(path, expect_kind="oracle")
     try:
         n = int(meta["n"])
@@ -177,7 +213,7 @@ def load_doc(path: str):
         raise CheckpointError(f"checkpoint {path!r}: bad meta: {e}") from e
 
     try:
-        return _rebuild_oracle(z, n, agents)
+        return _rebuild_oracle(z, n, agents), meta
     except (KeyError, ValueError, IndexError) as e:
         raise CheckpointError(
             f"checkpoint {path!r}: inconsistent contents: {e}") from e
@@ -215,6 +251,190 @@ def _rebuild_oracle(z, n: int, agents):
     for (order, length, shadow), ps in zip(z["txns"], parents_by_txn):
         doc.txns.append(TxnSpan(int(order), int(length), int(shadow), ps))
     return doc
+
+
+# -- incremental (delta) checkpoints -----------------------------------------
+
+def save_delta(doc, path: str, *, base_crc: int, prev_crc: int,
+               from_order: int) -> dict:
+    """Write the history ``from_order..`` as one delta link at ``path``.
+
+    ``prev_crc`` names the immediate predecessor file (the base for the
+    first link, the previous delta after that) and ``base_crc`` the
+    chain's base — both are content CRCs, so a link can never be
+    replayed onto the wrong snapshot.  The ops ride as a columnar wire
+    stream (``net/columnar.encode_txns_stream``): the save costs
+    O(ops since ``from_order``), not O(doc).
+    """
+    from ..models.sync import export_txns_since
+    from ..net import columnar
+
+    next_order = doc.get_next_order()
+    if from_order > next_order:
+        raise CheckpointError(
+            f"delta from_order {from_order} is ahead of the document "
+            f"({next_order}) — stale chain state, re-save a full base")
+    blob = columnar.encode_txns_stream(export_txns_since(doc, from_order))
+    meta = {
+        "version": FORMAT_VERSION,
+        "kind": "delta",
+        "base_crc": int(base_crc),
+        "prev_crc": int(prev_crc),
+        "from_order": int(from_order),
+        "next_order": int(next_order),
+    }
+    arrays = dict(txns_blob=np.frombuffer(blob, dtype=np.uint8))
+    crc = _save_npz(path, meta, arrays)
+    return {"crc": crc, "next_order": next_order,
+            "ops": next_order - from_order,
+            "bytes": os.path.getsize(path)}
+
+
+def load_delta(path: str):
+    """Load + fully validate one delta link; returns
+    ``(meta, [RemoteTxn])``. Corruption anywhere — file, meta, or the
+    embedded wire stream — is a typed ``CheckpointError``."""
+    from ..net import codec
+
+    meta, arrays = _load_npz(path, expect_kind="delta")
+    for key in ("base_crc", "prev_crc", "from_order", "next_order"):
+        if not isinstance(meta.get(key), int):
+            raise CheckpointError(
+                f"delta checkpoint {path!r}: missing/invalid {key!r} meta")
+    blob = bytes(arrays["txns_blob"].tobytes()) \
+        if "txns_blob" in arrays else None
+    if blob is None:
+        raise CheckpointError(
+            f"delta checkpoint {path!r} has no txns_blob member")
+    txns: List = []
+    try:
+        for kind, value in codec.decode_frames(blob):
+            if kind != codec.KIND_TXNS:
+                raise CheckpointError(
+                    f"delta checkpoint {path!r}: non-TXNS frame in blob")
+            txns.extend(value)
+    except codec.CodecError as e:
+        raise CheckpointError(
+            f"delta checkpoint {path!r}: corrupt txn stream: {e}") from e
+    return meta, txns
+
+
+def replay_chain(base_path: str, delta_paths: List[str]):
+    """Restore a document from ``base`` + delta links, verifying every
+    chain invariant: each link's ``prev_crc`` must equal the content CRC
+    of its predecessor file, ``base_crc`` the base's, and the order
+    intervals must tile ``base.next_order..`` exactly.  Replay applies
+    the decoded txns in stream order — order assignment is sequential,
+    so the restored document is the one the live replica held.
+    """
+    doc, base_meta = _load_doc_with_meta(base_path)
+    base_crc = base_meta["crc"]
+    prev_crc = base_crc
+    cursor = int(base_meta.get("next_order", 0))
+    for link_path in delta_paths:
+        meta, txns = load_delta(link_path)
+        if meta["base_crc"] != base_crc:
+            raise CheckpointError(
+                f"delta {link_path!r} references base crc "
+                f"{meta['base_crc']:#010x}, chain base is {base_crc:#010x} "
+                f"— stale or foreign base, refusing to replay")
+        if meta["prev_crc"] != prev_crc:
+            raise CheckpointError(
+                f"delta {link_path!r} references predecessor crc "
+                f"{meta['prev_crc']:#010x}, got {prev_crc:#010x} — "
+                f"broken chain, refusing to replay")
+        if meta["from_order"] != cursor:
+            raise CheckpointError(
+                f"delta {link_path!r} starts at order {meta['from_order']}, "
+                f"chain cursor is {cursor} — missing or reordered link")
+        try:
+            for txn in txns:
+                doc.apply_remote_txn(txn)
+        except (AssertionError, KeyError, ValueError, IndexError) as e:
+            raise CheckpointError(
+                f"delta {link_path!r}: replay failed: {e}") from e
+        if doc.get_next_order() != meta["next_order"]:
+            raise CheckpointError(
+                f"delta {link_path!r}: replay landed at order "
+                f"{doc.get_next_order()}, link claims {meta['next_order']}")
+        prev_crc = meta["crc"]
+        cursor = meta["next_order"]
+    return doc
+
+
+class CheckpointChain:
+    """One document's base + delta links with periodic compaction.
+
+    ``save(doc)`` writes a delta link when the chain is warm and small,
+    or folds everything into a fresh base once the chain carries more
+    than ``compact_ops`` ops or ``compact_links`` links (restore cost
+    and directory clutter stay bounded).  ``load()`` replays the chain
+    with full integrity checking.  File layout: ``<stem>.base.npz`` +
+    ``<stem>.d<k>.npz``.
+    """
+
+    def __init__(self, stem: str, *, compact_ops: int = 4096,
+                 compact_links: int = 16):
+        self.stem = stem
+        self.compact_ops = max(1, compact_ops)
+        self.compact_links = max(1, compact_links)
+        self.base_path = f"{stem}.base.npz"
+        self.base_info: Optional[dict] = None
+        self.links: List[dict] = []   # {"path", "crc", "next_order", ...}
+
+    @property
+    def next_order(self) -> Optional[int]:
+        if self.links:
+            return self.links[-1]["next_order"]
+        return self.base_info["next_order"] if self.base_info else None
+
+    def _link_path(self) -> str:
+        return f"{self.stem}.d{len(self.links):04d}.npz"
+
+    def save(self, doc) -> dict:
+        """Checkpoint ``doc``; returns ``{"kind", "bytes", "ops"}`` —
+        what the residency layer's byte counters record.
+
+        An unchanged doc (tip already == ``next_order`` — e.g. a
+        restore-for-read immediately re-evicted) writes NOTHING and
+        returns kind ``"noop"``: the existing chain already restores
+        this exact state, and an empty link per idle evict would walk
+        the chain toward a pointless full-base compaction."""
+        tip = self.next_order
+        if tip is not None and tip == doc.get_next_order():
+            return {"kind": "noop", "bytes": 0, "ops": 0}
+        ops_since_base = (doc.get_next_order() - self.base_info["next_order"]
+                          if self.base_info else None)
+        fresh = (
+            self.base_info is None
+            or tip is None or tip > doc.get_next_order()
+            or ops_since_base > self.compact_ops
+            or len(self.links) >= self.compact_links
+        )
+        if fresh:
+            for link in self.links:
+                if os.path.exists(link["path"]):
+                    os.remove(link["path"])
+            self.links = []
+            self.base_info = save_doc(doc, self.base_path)
+            return {"kind": "full", "bytes": self.base_info["bytes"],
+                    "ops": self.base_info["next_order"]}
+        path = self._link_path()
+        prev_crc = self.links[-1]["crc"] if self.links \
+            else self.base_info["crc"]
+        info = save_delta(doc, path, base_crc=self.base_info["crc"],
+                          prev_crc=prev_crc, from_order=tip)
+        info["path"] = path
+        self.links.append(info)
+        return {"kind": "delta", "bytes": info["bytes"], "ops": info["ops"]}
+
+    def load(self):
+        """Restore the chained document (typed refusal on any broken
+        link)."""
+        if self.base_info is None:
+            raise CheckpointError(f"chain {self.stem!r} has no base")
+        return replay_chain(self.base_path,
+                            [link["path"] for link in self.links])
 
 
 def save_flat_doc(flat, path: str) -> None:
