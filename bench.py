@@ -118,6 +118,61 @@ def init_devices(max_tries: int = 3):
             time.sleep(30.0 * (t + 1))
 
 
+# -- bench row exporter schema (ISSUE 8 satellite) ----------------------------
+# Every non-error row BENCH_ALL.json carries must validate against this
+# floor: ``--merge-rows`` and the RowSink refuse shape-drifted rows at
+# write time, and ``tests/test_bench_row_schema.py`` validates the
+# committed table — so a silent field rename or type drift can't split
+# the table into incomparable halves (the scattered-dicts failure mode
+# the obs/ registry exists to end).  Extra per-config fields are fine;
+# the schema pins the shared floor, not the ceiling.
+ROW_SCHEMA_VERSION = 1
+ROW_SCHEMA = {
+    "schema_version": (int,),
+    "cfg_key": (str,),
+    "variant": (str,),
+    "config": (str,),
+    "engine": (str,),
+    "metric": (str,),
+    "value": (int, float),
+    "unit": (str,),
+    "batch": (int,),
+    "ops": (int,),
+    "device_steps": (int,),
+    "mean_step_latency_us": (int, float),
+    "hbm_bytes_accounted": (int,),
+    "hbm_bytes_measured": (int, type(None)),
+    "vs_baseline": (int, float, type(None)),
+    "baseline_ops_per_sec": (int, float, type(None)),
+    "oracle_equal": (bool, type(None)),
+}
+
+
+def validate_row(row: dict) -> None:
+    """Raise ``ValueError`` naming every schema violation in one bench
+    row. Error placeholder rows (``"error"`` key) are exempt — they
+    carry a crash record, not metrics."""
+    if "error" in row:
+        return
+    problems = []
+    for field, types in ROW_SCHEMA.items():
+        if field not in row:
+            problems.append(f"missing field {field!r}")
+        elif not isinstance(row[field], types):
+            problems.append(
+                f"field {field!r} has type "
+                f"{type(row[field]).__name__}, wants "
+                f"{'/'.join(t.__name__ for t in types)}")
+    if not problems and row["schema_version"] != ROW_SCHEMA_VERSION:
+        problems.append(
+            f"schema_version {row['schema_version']} != "
+            f"{ROW_SCHEMA_VERSION} (re-record through this exporter)")
+    if problems:
+        raise ValueError(
+            f"bench row {row.get('config')!r} violates the exporter "
+            f"schema: {'; '.join(problems)}")
+
+
 class RowSink:
     """Persist bench rows to ``path`` AS THEY COMPLETE (VERDICT r3 next
     #1: a crash mid-suite must not lose finished rows), and support
@@ -175,6 +230,7 @@ class RowSink:
         for row in (out if isinstance(out, list) else [out]):
             row["cfg_key"] = key
             row["variant"] = self.variant
+            validate_row(row)  # shape-drifted rows fail at write time
             self.rows.append(row)
         self.pending.pop(key, None)  # the re-run supersedes them now
         self.flush()
@@ -230,6 +286,9 @@ def merge_config_rows(path, key, rows, variant, smoke=False):
     for row in rows:
         row["cfg_key"] = key
         row["variant"] = variant
+        # Schema gate (ISSUE 8): a shape-drifted single-config re-record
+        # must not merge into the table it can no longer be compared to.
+        validate_row(row)
     kept = [r for r in prior if r.get("cfg_key") != key]
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
@@ -348,6 +407,7 @@ def make_row(config, engine, n_ops, batch, wall, steps, hbm_bytes,
     ops_per_sec = total / wall
     measured, measured_note = measured_device_bytes()
     row = {
+        "schema_version": ROW_SCHEMA_VERSION,
         "config": config,
         "engine": engine,
         "metric": "crdt_ops_per_sec_chip",
@@ -1259,6 +1319,13 @@ def cfg_serve(args):
         steps_fused=report["tick_ms"].get("fused_rows_saved", 0),
         steps_prefuse=report["tick_ms"].get("steps_prefuse", 0),
         ops_per_step=report["tick_ms"].get("ops_per_step", 1.0),
+        # ISSUE 8: distribution keys (not just means) + trace counters,
+        # all flowing from the server's one MetricsRegistry.
+        ops_per_step_p99=report["tick_ms"].get("ops_per_step_p99", 0.0),
+        ops_per_step_max=report["tick_ms"].get("ops_per_step_max", 0.0),
+        device_compiles=report["obs"]["device_compiles"],
+        trace_events=report["obs"]["trace_events"],
+        obs_bundles=report["obs"]["bundles_written"],
         wire_format=col_wire["format"],
         ckpt_format=report["ckpt"]["format"],
         wire_bytes_total=col_wire["txn_bytes"],
@@ -1327,6 +1394,10 @@ def cfg_serve_lanes(args):
         steps_fused=rep["tick_ms"].get("fused_rows_saved", 0),
         steps_prefuse=rep["tick_ms"].get("steps_prefuse", 0),
         ops_per_step=rep["tick_ms"].get("ops_per_step", 1.0),
+        ops_per_step_p99=rep["tick_ms"].get("ops_per_step_p99", 0.0),
+        ops_per_step_max=rep["tick_ms"].get("ops_per_step_max", 0.0),
+        device_compiles=(rep.get("obs") or {}).get("device_compiles", 0),
+        trace_events=(rep.get("obs") or {}).get("trace_events", 0),
         p50_admission_to_applied_us=rep["latency_us"]["p50"],
         p99_admission_to_applied_us=rep["latency_us"]["p99"],
         evictions=rep["evictions"], restores=rep["restores"],

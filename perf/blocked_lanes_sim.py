@@ -683,6 +683,10 @@ def serve_workload(smoke: bool = False, block_k: int = 0,
                     "ckpt_delta_bytes_per_evict_mean", 0.0),
                 "ckpt_full_bytes_per_evict": r["server"].get(
                     "ckpt_full_bytes_per_evict_mean", 0.0),
+                # ISSUE 8: the obs registry/tracer block rides along so
+                # the serve-lanes bench row records the same
+                # observability fields as the serve row.
+                "obs": r.get("obs"),
             }
             for eng, r in reports.items()
         },

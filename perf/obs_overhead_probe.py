@@ -1,0 +1,139 @@
+"""Trace-overhead probe (ISSUE 8 acceptance): tracing-on vs tracing-off
+loadgen wall delta at the 200-doc acceptance shape.
+
+The obs/ tracer is DEFAULT-ON in ``ServeConfig`` — the flight recorder
+is only useful if it was running when the failure happened — so its
+cost must be pinned, not assumed.  The probe runs the same seeded
+loadgen three ways:
+
+- ``off``  — ``ServeConfig(trace=False)``: the tracer no-ops, the
+  registry still counts (counters were always on);
+- ``on``   — the default: tracer + ring + recorder + histograms;
+- ``on2``  — a second traced run, whose logical trace must be
+  BYTE-IDENTICAL to ``on``'s (the determinism guard at full scale,
+  not just the tier-1 small shape).
+
+Each timing arm takes the MIN of ``reps`` runs (wall noise on a shared
+box swamps a percent-level delta; min-of-N is the standard defense —
+the same argument as bench.py's baseline sampling), and the loop wall
+(``device_ticks_wall_s``, the serving loop only) is the comparison
+basis — verification/drain phases are not serving cost.
+
+Acceptance: overhead < 5% (``floor``), both runs converged, traces
+byte-identical.  Writes ``perf/obs_overhead_r11.json``.
+
+Run: python perf/obs_overhead_probe.py [--smoke] [--reps N] [--out PATH]
+"""
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except RuntimeError:
+    pass  # in-process import after backend init (the tier-1 smoke)
+
+from text_crdt_rust_tpu.config import ServeConfig  # noqa: E402
+from text_crdt_rust_tpu.serve.loadgen import ServeLoadGen  # noqa: E402
+
+FLOOR_PCT = 5.0
+
+
+def run_one(trace: bool, smoke: bool, seed: int = 7,
+            keep_trace: bool = False):
+    """One seeded loadgen run; returns (report, logical_trace_bytes)."""
+    docs, ticks, events = (24, 12, 16) if smoke else (200, 60, 48)
+    cfg = ServeConfig(engine="flat", num_shards=2, lanes_per_shard=16,
+                      trace=trace, trace_keep=keep_trace)
+    gen = ServeLoadGen(docs=docs, agents_per_doc=3, ticks=ticks,
+                       events_per_tick=events, zipf_alpha=1.1,
+                       fault_rate=0.10, local_prob=0.25, seed=seed,
+                       cfg=cfg)
+    rep = gen.run()
+    assert rep["converged"], rep["mismatches"][:4]
+    trace_bytes = (gen.server.tracer.logical_bytes()
+                   if keep_trace else None)
+    return rep, trace_bytes
+
+
+def run_matrix(smoke: bool = False, reps: int = 2) -> dict:
+    arms = {}
+    timings = {"off": [], "on": []}
+    for arm in ("off", "on"):
+        for _r in range(reps):
+            # Timed arms NEVER set trace_keep: retaining the full event
+            # list in memory is a test-harness cost the shipped default
+            # (ring only) doesn't pay, and it must not contaminate the
+            # <5% acceptance number.
+            t0 = time.perf_counter()
+            rep, _ = run_one(arm == "on", smoke)
+            wall = time.perf_counter() - t0
+            timings[arm].append({
+                "total_wall_s": round(wall, 3),
+                "loop_wall_s": rep["device_ticks_wall_s"],
+            })
+            arms[arm] = rep
+    # Determinism at the probe shape, measured on two UNTIMED traced
+    # runs: byte-identical logical streams.
+    _repa, trace_a = run_one(True, smoke, keep_trace=True)
+    _repb, trace_b = run_one(True, smoke, keep_trace=True)
+    trace_identical = trace_a == trace_b
+
+    loop_off = min(t["loop_wall_s"] for t in timings["off"])
+    loop_on = min(t["loop_wall_s"] for t in timings["on"])
+    total_off = min(t["total_wall_s"] for t in timings["off"])
+    total_on = min(t["total_wall_s"] for t in timings["on"])
+    overhead_pct = round((loop_on - loop_off) / loop_off * 100.0, 2)
+    out = {
+        "probe": "obs_overhead",
+        "smoke": smoke,
+        "workload": {
+            "docs": arms["on"]["docs"], "seed": 7, "engine": "flat",
+            "fault_rate": 0.10, "reps_per_arm": reps,
+            "basis": "min loop wall (device_ticks_wall_s) per arm",
+        },
+        "loop_wall_s": {"off": round(loop_off, 3), "on": round(loop_on, 3)},
+        "total_wall_s": {"off": round(total_off, 3),
+                         "on": round(total_on, 3)},
+        "overhead_pct": overhead_pct,
+        "total_overhead_pct": round(
+            (total_on - total_off) / total_off * 100.0, 2),
+        "trace_events": arms["on"]["obs"]["trace_events"],
+        "trace_bytes_logical": len(trace_a) if trace_a else 0,
+        "trace_byte_identical_across_runs": trace_identical,
+        "converged": {k: arms[k]["converged"] for k in arms},
+        "acceptance": {
+            "floor_pct": FLOOR_PCT,
+            "pass": bool(overhead_pct < FLOOR_PCT and trace_identical
+                         and all(a["converged"] for a in arms.values())),
+        },
+        "note": "CPU run (tier-1 harness); the tracer cost is host-side "
+                "python (event dicts + ring append) and does not change "
+                "with the device backend, so the CPU bound transfers. "
+                "Negative overhead = run-to-run noise floor exceeds the "
+                "tracer cost.",
+    }
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--reps", type=int, default=2)
+    ap.add_argument("--out", default="perf/obs_overhead_r11.json")
+    a = ap.parse_args()
+    out = run_matrix(smoke=a.smoke, reps=a.reps)
+    with open(a.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out, indent=1))
+    if not out["acceptance"]["pass"]:
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

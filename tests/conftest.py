@@ -11,9 +11,12 @@ time conftest runs); the supported override is
 host-device count, which is read lazily when the CPU client is first
 created.
 """
+import glob
 import os
 import sys
 import time
+
+import pytest
 
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
@@ -68,6 +71,37 @@ def pytest_sessionfinish(session, exitstatus):
             and os.environ.get("TCR_TIER1_BUDGET_FAIL")):
         session.exitstatus = 3  # pytest's "internal error"-class exit:
         #                         loud and unambiguous in CI logs
+
+
+# --- flight-recorder attach on serve-test failures (ISSUE 8 satellite) ------
+# With TCR_TRACE_DIR set, every DocServer built during the run writes
+# its post-mortem bundles there (serve/server.py reads the env as the
+# obs_dir default).  Any failing tests/test_serve_* test then gets the
+# bundle paths attached to its pytest report section, so a tier-1
+# failure ships its own post-mortem instead of just an assert message:
+#
+#     TCR_TRACE_DIR=/tmp/tcr_obs pytest tests/ -m 'not slow'
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    outcome = yield
+    rep = outcome.get_result()
+    tdir = os.environ.get("TCR_TRACE_DIR")
+    if not (tdir and rep.failed
+            and os.path.basename(str(item.fspath)).startswith(
+                ("test_serve_", "test_obs_"))):
+        return
+    # Only bundles written DURING this session: the dir is long-lived
+    # and stale bundles from a previous run would mislead the triage.
+    bundles = sorted(
+        p for p in glob.glob(os.path.join(tdir, "**", "bundle_*.json"),
+                             recursive=True)
+        if os.path.getmtime(p) >= _SESSION_T0)
+    rep.sections.append((
+        "flight-recorder (TCR_TRACE_DIR)",
+        "\n".join(bundles) if bundles
+        else f"no post-mortem bundles under {tdir} from this session"))
 
 
 def pytest_collection_modifyitems(config, items):

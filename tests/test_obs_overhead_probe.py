@@ -1,0 +1,53 @@
+"""Tier-1 smoke for ``perf/obs_overhead_probe.py`` (ISSUE 8 satellite):
+the committed ``perf/obs_overhead_r11.json`` is produced by the probe's
+full 200-doc path; this keeps the small-scale path green (converged on
+both arms, trace byte-identity held, acceptance fields present) so the
+JSON can't silently rot, and a ``slow``-tier check re-validates the
+committed file's claims structurally."""
+import json
+import os
+import importlib.util
+
+import pytest
+
+PROBE = os.path.join("perf", "obs_overhead_probe.py")
+COMMITTED = os.path.join("perf", "obs_overhead_r11.json")
+
+
+def _load_probe():
+    spec = importlib.util.spec_from_file_location("oop", PROBE)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_probe_smoke_path_green():
+    out = _load_probe().run_matrix(smoke=True, reps=1)
+    assert out["converged"] == {"off": True, "on": True}
+    assert out["trace_byte_identical_across_runs"]
+    assert out["trace_events"] > 100
+    assert "overhead_pct" in out and "loop_wall_s" in out
+    assert out["acceptance"]["floor_pct"] == 5.0
+
+
+def test_committed_overhead_json_claims():
+    """The committed probe JSON's acceptance claims: tracing-on wall
+    within 5% of tracing-off at the 200-doc shape, traces
+    byte-identical, both arms converged. Structural re-validation is
+    tier-1 cheap; the full re-measurement is the probe CLI itself."""
+    with open(COMMITTED) as f:
+        d = json.load(f)
+    assert not d["smoke"], "committed JSON must be the full 200-doc run"
+    assert d["workload"]["docs"] == 200
+    assert d["acceptance"]["pass"]
+    assert d["overhead_pct"] < d["acceptance"]["floor_pct"]
+    assert d["trace_byte_identical_across_runs"]
+    assert all(d["converged"].values())
+
+
+@pytest.mark.slow
+def test_probe_full_rerun_matches_committed_claims():
+    """Re-measure at full scale (slow tier): the acceptance must
+    reproduce on the current code, not just parse."""
+    out = _load_probe().run_matrix(smoke=False, reps=2)
+    assert out["acceptance"]["pass"], out

@@ -232,6 +232,23 @@ class ServeConfig:
     ckpt_compact_ops: int = 4096   # delta chain: fold into a fresh base
     #                            once ops-since-base exceed this
     ckpt_compact_links: int = 16   # ... or the chain grows this long
+    # -- observability (ISSUE 8: obs/) --------------------------------------
+    trace: bool = True         # logical-clock event tracer (obs/trace):
+    #                            default ON — the overhead probe pins it
+    #                            <5% of loadgen wall (PERF.md §14)
+    trace_ring: int = 512      # flight-recorder ring: last-N events
+    trace_path: Optional[str] = None  # stream every event to this JSONL
+    #                            file (logical + segregated wall fields)
+    trace_keep: bool = False   # retain the full event list in memory
+    #                            (the trace-determinism tests read it
+    #                            back via Tracer.logical_bytes)
+    obs_dir: Optional[str] = None  # post-mortem bundle directory;
+    #                            None = $TCR_TRACE_DIR or
+    #                            <spool_dir>/obs
+    profile_dir: Optional[str] = None  # opt-in jax.profiler capture:
+    #                            start a device trace into this dir at
+    #                            tick 1, stop after profile_ticks
+    profile_ticks: int = 3     # ticks per jax.profiler capture window
 
     def add_args(self, ap: argparse.ArgumentParser) -> None:
         ap.add_argument("--serve-shards", type=int, default=self.num_shards)

@@ -253,9 +253,16 @@ class ResyncSession:
                  digest_every: int = 1,
                  mirror: Optional[DeviceMirror] = None,
                  counters: Optional[Counters] = None,
-                 wire: str = "row"):
+                 wire: str = "row", tracer=None, recorder=None):
         self.doc = doc
         self.wire = wire
+        # Optional ``obs.trace.Tracer``: one ``resync.round`` event per
+        # poll that re-requests ranges (logical-tick-stamped, so mesh
+        # anti-entropy behavior is reconstructible post-hoc).  The
+        # optional ``obs.recorder.FlightRecorder`` dumps a post-mortem
+        # when a gap outlives the retry budget (``CausalGapError``).
+        self.tracer = tracer
+        self.recorder = recorder
         self._encode_txns = codec.txns_encoder(wire)
         # The columnar wire amortizes its name table + column headers
         # across the batch, so it ships far bigger frames; the row wire
@@ -359,7 +366,14 @@ class ResyncSession:
             if entry is None or from_seq > entry[2]:
                 continue  # first ask / new gap: budget (re)starts fresh
             if self._tick >= entry[1] and entry[0] + 1 > self.retry_limit:
-                raise CausalGapError(wanted, entry[0])
+                err = CausalGapError(wanted, entry[0])
+                if self.recorder is not None:
+                    self.recorder.on_failure(
+                        "causal-gap", str(err),
+                        tick=self._tick,
+                        extra={"wanted": dict(wanted),
+                               "attempts": entry[0]})
+                raise err
         due: Dict[str, int] = {}
         for agent, from_seq in sorted(wanted.items()):
             entry = self._requests.setdefault(
@@ -400,6 +414,9 @@ class ResyncSession:
         if due:
             frames.append(codec.encode_request(due))
             self.counters.incr("frames_sent")
+            if self.tracer is not None:
+                self.tracer.set_tick(self._tick)
+                self.tracer.event("resync.round", wants=len(due))
 
         self.counters.hiwater("buffer_high_water", self.buffer.high_water)
         return frames

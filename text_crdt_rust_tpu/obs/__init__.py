@@ -1,0 +1,22 @@
+"""Structured observability for the serving stack (ISSUE 8).
+
+Three layers, each consumable on its own:
+
+- ``obs.trace``    — a logical-clock-first span/event tracer emitting
+                     versioned JSONL with wall-clock fields segregated,
+                     so two same-seed runs produce byte-identical
+                     *logical* traces (the determinism oracle);
+- ``obs.registry`` — one metrics registry (counters + gauges + bounded
+                     histograms) with JSONL and Prometheus-text
+                     exporters, unifying what used to be scattered
+                     across ``Counters``, ``tick_summary`` and ad-hoc
+                     report dicts;
+- ``obs.recorder`` — a bounded flight recorder that, on any typed
+                     failure or twin/lane bit-identity mismatch, dumps
+                     a post-mortem bundle (last-N events, counters,
+                     doc stats, the offending tick's compiled-step
+                     metadata, and a first-divergence walk).
+"""
+from .recorder import FlightRecorder  # noqa: F401
+from .registry import Histogram, MetricsRegistry, observe  # noqa: F401
+from .trace import TRACE_SCHEMA_VERSION, Tracer, validate_event  # noqa: F401

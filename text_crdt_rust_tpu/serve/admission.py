@@ -91,7 +91,8 @@ class AdmissionControl:
     def __init__(self, *, max_queue_per_doc: int, max_queue_global: int,
                  max_txn_len: int, rate_capacity: int = 0,
                  rate_refill: int = 0,
-                 counters: Optional[Counters] = None):
+                 counters: Optional[Counters] = None,
+                 tracer=None):
         assert max_queue_per_doc >= 1 and max_queue_global >= 1
         self.max_queue_per_doc = max_queue_per_doc
         self.max_queue_global = max_queue_global
@@ -99,11 +100,14 @@ class AdmissionControl:
         self.rate_capacity = rate_capacity
         self.rate_refill = rate_refill
         self.counters = counters if counters is not None else Counters()
+        self.tracer = tracer
         self.global_pending = 0
         self._buckets: Dict[str, TokenBucket] = {}
 
     def _reject(self, reason: str, detail: str) -> AdmissionError:
         self.counters.incr(f"rejected_{reason.replace('-', '_')}")
+        if self.tracer is not None:
+            self.tracer.event("admission.reject", reason=reason)
         return AdmissionError(reason, detail)
 
     def reject_frame(self, detail: str) -> AdmissionError:

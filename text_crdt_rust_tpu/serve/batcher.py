@@ -38,6 +38,7 @@ import numpy as np
 from ..common import LocalOp, RemoteDel, RemoteIns, RemoteTxn
 from ..net.session import txn_refs_known
 from ..models.sync import agent_watermarks
+from ..obs.registry import observe
 from ..ops import batch as B
 from ..ops import flat as F
 from ..ops import span_arrays as SA
@@ -248,13 +249,16 @@ class ContinuousBatcher:
     def __init__(self, router: ShardRouter, residency, *,
                  step_buckets: Tuple[int, ...], lmax: int,
                  counters: Optional[Counters] = None,
-                 fuse_steps: bool = False, fuse_w: int = 1):
+                 fuse_steps: bool = False, fuse_w: int = 1,
+                 tracer=None, recorder=None):
         assert tuple(sorted(step_buckets)) == tuple(step_buckets)
         self.router = router
         self.residency = residency
         self.step_buckets = tuple(step_buckets)
         self.lmax = lmax
         self.counters = counters if counters is not None else Counters()
+        self.tracer = tracer
+        self.recorder = recorder
         # Generalized tick-stream fusion (``ops.batch.fuse_steps``,
         # ISSUE 6): each lane doc's drained tick stream is fused before
         # the capacity probe and stacking — typing runs / sweeps /
@@ -318,6 +322,12 @@ class ContinuousBatcher:
         oracle.apply_local_txn(aid, [LocalOp(pos=pos, ins_content=ins,
                                              del_span=del_len)])
         doc.assigner.assign(doc.table.id_of(agent), seq0, event.items)
+        if self.tracer is not None:
+            # The event-level audit log the divergence post-mortem
+            # joins against: WHICH (agent, seq) span landed on WHICH
+            # logical tick.
+            self.tracer.event("apply", doc=doc.doc_id, ev="local",
+                              agent=agent, seq=seq0, n=event.items)
         if not compile_device:
             return True, None
         ops, next_o = B.compile_local_patches(
@@ -339,6 +349,10 @@ class ContinuousBatcher:
             return False, None
         self._grow_table(doc, ShardRouter.txn_agent_names(txn))
         doc.oracle.apply_remote_txn(txn)
+        if self.tracer is not None:
+            self.tracer.event("apply", doc=doc.doc_id, ev="txn",
+                              agent=txn.id.agent, seq=txn.id.seq,
+                              n=event.items)
         if not compile_device:
             # Host-only doc: advance the compiler's order metadata the
             # exact way compile_remote_txns would (whole-txn span) but
@@ -421,6 +435,9 @@ class ContinuousBatcher:
     def tick(self, tick_no: int) -> Dict[str, float]:
         """One serving tick across all shards; returns tick stats."""
         t0 = time.perf_counter()
+        tr = self.tracer
+        if tr is not None:
+            tr.set_tick(tick_no)
         stats = {"ops_applied": 0, "events_applied": 0, "steps": 0,
                  "lanes_active": 0}
 
@@ -439,9 +456,13 @@ class ContinuousBatcher:
         #    Host-only docs drain without tensor emission (nothing would
         #    consume the streams — the oracle apply is the whole serve).
         applied_events: List[Event] = []
+        active_shards: set = set()
         for shard, backend in enumerate(self.residency.backends):
             lane_streams: Dict[int, B.OpTensors] = {}
             host_only_applies = 0
+            shard_events = 0
+            shard_steps = 0
+            probed = degraded = 0
             budget = self.step_buckets[-1]
             for doc in self.router.docs.values():
                 if doc.shard != shard or not doc.events:
@@ -453,6 +474,8 @@ class ContinuousBatcher:
                 applied_events.extend(applied)
                 stats["events_applied"] += len(applied)
                 stats["ops_applied"] += sum(e.items for e in applied)
+                shard_events += len(applied)
+                shard_steps += steps
                 fs = None
                 if (self.fuse_steps and doc.in_lane
                         and stream is not None):
@@ -480,6 +503,7 @@ class ContinuousBatcher:
                     # frees the lane, skips the device — never asserts.
                     # Backends define their own unit (chars for flat,
                     # run rows + split headroom for the blocked lanes).
+                    probed += 1
                     if backend.tick_fits(doc.lane, doc.oracle, stream):
                         if self.step_trace is not None:
                             self.step_trace(doc.doc_id, stream)
@@ -492,7 +516,23 @@ class ContinuousBatcher:
                             # not inflate the exported device-step
                             # counters.
                             self.fuse_stats.merge(fs)
+                            if tr is not None and fs.rows_saved > 0:
+                                tr.event("tick.fuse", doc=doc.doc_id,
+                                         steps_in=fs.steps_in,
+                                         steps_out=fs.steps_out)
+                            observe(self.counters, "ops_per_step",
+                                    fs.reduction_x)
+                            observe(self.counters, "fused_rows_saved",
+                                    fs.rows_saved)
+                        if self.recorder is not None:
+                            self.recorder.record_stream(doc.doc_id, {
+                                "tick": tick_no,
+                                "num_steps": int(stream.num_steps),
+                                "steps_prefuse": (fs.steps_in if fs
+                                                  else int(stream.num_steps)),
+                            })
                     else:
+                        degraded += 1
                         self.residency.degrade(
                             doc, f"lane capacity overflow: {doc.oracle.n} "
                                  f"rows / {doc.oracle.get_next_order()} "
@@ -501,18 +541,46 @@ class ContinuousBatcher:
                 elif not doc.in_lane and applied:
                     host_only_applies += 1
 
+            if tr is not None and (shard_events or shard_steps):
+                tr.event("tick.drain", shard=shard, events=shard_events,
+                         steps=shard_steps)
+            if tr is not None and probed:
+                tr.event("tick.capacity", shard=shard, probed=probed,
+                         degraded=degraded)
             if lane_streams:
+                active_shards.add(shard)
                 s_max = max(s.num_steps for s in lane_streams.values())
                 s_bkt = self.bucket(s_max)
+                # Recompile tracking promoted from the backend's
+                # ``shapes_seen`` assert to a first-class trace event
+                # (ISSUE 8): steady state must stop emitting these.
+                seen = getattr(backend, "shapes_seen", None)
+                fresh_shape = seen is not None and s_bkt not in seen
                 per_lane = [
                     B.pad_ops(lane_streams.get(b, B.empty_ops(self.lmax)),
                               s_bkt)
                     for b in range(backend.lanes)
                 ]
                 stacked = B.stack_ops(per_lane)
+                t_dev = time.perf_counter()
                 backend.apply(stacked)
-                stats["lanes_active"] += len(lane_streams)
+                disp_ms = (time.perf_counter() - t_dev) * 1e3
                 real = sum(s.num_steps for s in lane_streams.values())
+                if fresh_shape:
+                    self.counters.incr("device_compiles")
+                    if tr is not None:
+                        tr.event("device.compile", shard=shard,
+                                 bucket=s_bkt)
+                if tr is not None:
+                    # Dispatch wall (host prefill + enqueue; the device
+                    # sync lands in tick.barrier) — segregated under
+                    # "w" so the logical stream stays seed-determined.
+                    tr.event("tick.device", shard=shard, bucket=s_bkt,
+                             lanes=len(lane_streams), steps=real,
+                             wall={"ms": round(disp_ms, 3)})
+                observe(self.counters, f"device_step_wall_ms_b{s_bkt}",
+                        disp_ms)
+                stats["lanes_active"] += len(lane_streams)
                 self.counters.sample(
                     "batch_fill_ratio",
                     real / float(s_bkt * backend.lanes))
@@ -523,8 +591,12 @@ class ContinuousBatcher:
         # 3. Barrier, then stamp admission->applied latency and sync
         #    causal watermarks with the oracles' out-of-band progress
         #    (local edits), releasing dependents for the next tick.
-        for backend in self.residency.backends:
-            backend.barrier()
+        for shard, backend in enumerate(self.residency.backends):
+            if tr is not None and shard in active_shards:
+                with tr.span("tick.barrier", shard=shard):
+                    backend.barrier()
+            else:
+                backend.barrier()
         now = time.perf_counter()
         for event in applied_events:
             self.latency_samples.append(now - event.t_submit)
@@ -536,4 +608,5 @@ class ContinuousBatcher:
                     self.router.enqueue_released(doc, released)
         stats["tick_wall_s"] = now - t0
         self.tick_wall_samples.append(stats["tick_wall_s"])
+        observe(self.counters, "tick_wall_ms", stats["tick_wall_s"] * 1e3)
         return stats

@@ -23,6 +23,7 @@ acceptance bar, CLI-runnable:
 from __future__ import annotations
 
 import argparse
+import os
 import random
 import time
 from typing import Dict, List, Optional, Set, Tuple
@@ -33,6 +34,7 @@ from ..models.oracle import ListCRDT
 from ..models.sync import agent_watermarks, export_txns_since, state_digest
 from ..net import codec, columnar
 from ..net.faults import FaultSpec, FaultyChannel
+from ..obs.trace import TRACE_SCHEMA_VERSION
 from ..parallel.causal import CausalBuffer
 from .admission import AdmissionError
 from .server import DocServer
@@ -499,9 +501,27 @@ class ServeLoadGen:
                 "saves_full": stats.get("ckpt_saves_full", 0),
                 "saves_delta": stats.get("ckpt_saves_delta", 0),
                 "bytes_per_evict": stats.get("ckpt_bytes_per_evict_mean", 0),
+                "bytes_per_evict_min": stats.get(
+                    "ckpt_bytes_per_evict_min", 0),
+                "bytes_per_evict_max": stats.get(
+                    "ckpt_bytes_per_evict_max", 0),
+            },
+            # Observability block (ISSUE 8): everything below flows
+            # from the ONE metrics registry + tracer the server owns.
+            "obs": {
+                "trace_enabled": self.cfg.trace,
+                "trace_schema": TRACE_SCHEMA_VERSION,
+                "trace_events": self.server.tracer.seq,
+                "device_compiles": stats.get("device_compiles", 0),
+                "bundles_written": stats.get("bundles_written", 0),
+                "bundles_suppressed": stats.get("bundles_suppressed", 0),
+                "bundles": list(self.server.recorder.bundle_paths),
             },
             "server": stats,
         }
+        # Finalize obs: stop a still-open profiler capture, flush+close
+        # the trace stream (the report above already read everything).
+        self.server.close_obs()
         return report
 
     def verify(self) -> Tuple[bool, List[str]]:
@@ -524,16 +544,41 @@ class ServeLoadGen:
             got = self.server.doc_string(world.doc_id)
             want = world.twin.to_string()
             if got != want:
+                bundle = self._postmortem(world, "content diverged")
                 bad.append(f"{world.doc_id}: content diverged "
-                           f"({len(got)} vs {len(want)} chars)")
+                           f"({len(got)} vs {len(want)} chars; "
+                           f"post-mortem: {bundle})")
                 continue
             doc = self.server.doc_state(world.doc_id)
             if state_digest(doc.oracle) != state_digest(world.twin):
-                bad.append(f"{world.doc_id}: state digest diverged")
+                bundle = self._postmortem(world, "state digest diverged")
+                bad.append(f"{world.doc_id}: state digest diverged "
+                           f"(post-mortem: {bundle})")
                 continue
             if not self.server.verify_doc(world.doc_id):
-                bad.append(f"{world.doc_id}: device lane != host oracle")
+                # verify_lane already dumped its own divergence bundle
+                # (or the run's one divergence bundle was spent earlier
+                # — point at that one, never at an unrelated class).
+                bundle = next(
+                    (p for p in reversed(self.server.recorder.bundle_paths)
+                     if "divergence" in os.path.basename(p)), None)
+                bad.append(f"{world.doc_id}: device lane != host oracle"
+                           + (f" (post-mortem: {bundle})" if bundle else ""))
         return not bad, bad
+
+    def _postmortem(self, world: _DocWorld, detail: str):
+        """Dump the twin-divergence flight-recorder bundle (ISSUE 8):
+        the first-divergence walk against the twin names the exact
+        logical tick, doc, and apply event where the histories parted."""
+        doc = self.server.doc_state(world.doc_id)
+        path = self.server.recorder.on_divergence(
+            world.doc_id, doc.oracle, world.twin,
+            detail=f"twin check: {detail}")
+        # Budget already spent on an earlier divergence this run: point
+        # at the bundle that WAS written instead of printing None.
+        return path or next(
+            (p for p in self.server.recorder.bundle_paths
+             if "divergence" in p), None)
 
 
 def main(argv=None) -> None:
@@ -570,6 +615,14 @@ def main(argv=None) -> None:
                     choices=("scatter", "typing"),
                     help="agent edit shape: uniform-random positions "
                          "or cursor-based typing runs")
+    ap.add_argument("--no-trace", action="store_true",
+                    help="disable the obs/ event tracer (the overhead "
+                         "probe's baseline arm)")
+    ap.add_argument("--trace-path", default=None,
+                    help="stream trace events to this JSONL file")
+    ap.add_argument("--profile-dir", default=None,
+                    help="opt-in jax.profiler capture directory "
+                         "(ticks 1..profile_ticks)")
     ap.add_argument("--verbose", action="store_true")
     a = ap.parse_args(argv)
 
@@ -579,7 +632,9 @@ def main(argv=None) -> None:
         jax.config.update("jax_platforms", "cpu")
     cfg = ServeConfig(engine=a.engine, num_shards=a.shards,
                       lanes_per_shard=a.lanes,
-                      wire_format=a.wire, ckpt_format=a.ckpt)
+                      wire_format=a.wire, ckpt_format=a.ckpt,
+                      trace=not a.no_trace, trace_path=a.trace_path,
+                      profile_dir=a.profile_dir)
     gen = ServeLoadGen(docs=a.docs, agents_per_doc=a.agents, ticks=a.ticks,
                        events_per_tick=a.events_per_tick, zipf_alpha=a.zipf,
                        fault_rate=a.fault_rate, local_prob=a.local_prob,

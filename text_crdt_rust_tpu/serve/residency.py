@@ -52,11 +52,14 @@ class LaneResidency:
                  counters: Optional[Counters] = None,
                  ckpt_format: str = "full",
                  ckpt_compact_ops: int = 4096,
-                 ckpt_compact_links: int = 16):
+                 ckpt_compact_links: int = 16,
+                 tracer=None):
         assert ckpt_format in ("full", "delta"), ckpt_format
         self.backends = backends
         self.router = router
         self.counters = counters if counters is not None else Counters()
+        self.tracer = tracer
+        self.recorder = None  # set by DocServer after construction
         self.spool_dir = spool_dir or tempfile.mkdtemp(prefix="tcr_serve_")
         os.makedirs(self.spool_dir, exist_ok=True)
         # "full" = one O(doc) snapshot per evict (the PR-3 behavior);
@@ -162,6 +165,12 @@ class LaneResidency:
         doc.degraded = True
         doc.degrade_reason = reason
         self.counters.incr("lane_overflow_degraded")
+        if self.tracer is not None:
+            self.tracer.event("residency.degrade", doc=doc.doc_id,
+                              reason=reason)
+        if self.recorder is not None:
+            self.recorder.on_failure("degrade", reason, doc_id=doc.doc_id,
+                                     shard=doc.shard, oracle=doc.oracle)
 
     # -- evict / restore -----------------------------------------------------
 
@@ -208,6 +217,9 @@ class LaneResidency:
         doc.evicted = True
         self.release_lane(doc)
         self.counters.incr("evictions")
+        if self.tracer is not None:
+            self.tracer.event("residency.evict", doc=doc.doc_id,
+                              ckpt=info["kind"], bytes=info.get("bytes", 0))
         return path
 
     def restore(self, doc: DocState, tick_no: Optional[int] = None) -> None:
@@ -218,10 +230,23 @@ class LaneResidency:
         never-evicted. ``tick_no`` stamps the touch so the same tick's
         LRU pass cannot immediately re-evict the doc it just restored."""
         assert doc.evicted and doc.ckpt_path
-        if self.ckpt_format == "delta":
-            oracle = self._chains[doc.doc_id].load()
-        else:
-            oracle = checkpoint.load_doc(doc.ckpt_path)
+        try:
+            if self.ckpt_format == "delta":
+                oracle = self._chains[doc.doc_id].load()
+            else:
+                oracle = checkpoint.load_doc(doc.ckpt_path)
+        except checkpoint.CheckpointError as e:
+            # Refusal is the contract (bit-perfect or nothing) — but it
+            # must leave a post-mortem behind: WHICH doc, which tick,
+            # what the server did to that checkpoint beforehand.
+            if self.tracer is not None:
+                self.tracer.event("residency.restore", doc=doc.doc_id,
+                                  error=str(e))
+            if self.recorder is not None:
+                self.recorder.on_failure("checkpoint", str(e),
+                                         doc_id=doc.doc_id,
+                                         shard=doc.shard, tick=tick_no)
+            raise
         doc.oracle = oracle
         doc.table = B.AgentTable([cd.name for cd in oracle.client_data])
         doc.assigner = B.OrderAssigner.from_oracle(oracle, doc.table)
@@ -229,6 +254,8 @@ class LaneResidency:
         if tick_no is not None:
             doc.last_touch_tick = tick_no
         self.counters.incr("restores")
+        if self.tracer is not None:
+            self.tracer.event("residency.restore", doc=doc.doc_id)
 
     # -- verification --------------------------------------------------------
 
@@ -241,4 +268,15 @@ class LaneResidency:
 
         got = self.backends[doc.shard].lane_signed(doc.lane)
         want = oracle_signed(doc.oracle)
-        return got.shape == want.shape and bool(np.array_equal(got, want))
+        ok = got.shape == want.shape and bool(np.array_equal(got, want))
+        if not ok:
+            if self.tracer is not None:
+                self.tracer.event("divergence", doc=doc.doc_id,
+                                  via="lane")
+            if self.recorder is not None:
+                self.recorder.on_failure(
+                    "divergence",
+                    f"device lane {doc.shard}/{doc.lane} != host oracle "
+                    f"({got.shape} vs {want.shape} rows)",
+                    doc_id=doc.doc_id, shard=doc.shard, oracle=doc.oracle)
+        return ok

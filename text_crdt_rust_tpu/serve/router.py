@@ -141,11 +141,13 @@ class ShardRouter:
     def __init__(self, num_shards: int, *, admission: AdmissionControl,
                  counters: Optional[Counters] = None,
                  buffer_max_pending: Optional[int] = 512,
-                 wire_format: str = "row"):
+                 wire_format: str = "row", tracer=None):
         assert num_shards >= 1
         self.num_shards = num_shards
         self.admission = admission
         self.counters = counters if counters is not None else Counters()
+        self.tracer = tracer
+        self.recorder = None  # set by DocServer after construction
         self.buffer_max_pending = buffer_max_pending
         # TXNS frames the router EMITS (serving REQUEST pulls); decode
         # always negotiates on the version byte, so what peers send is
@@ -266,9 +268,12 @@ class ShardRouter:
         ``AdmissionError`` — never an uncaught decode error."""
         doc = self.doc(doc_id)
         self.counters.incr("wire_bytes_in", len(data))
+        if self.recorder is not None:
+            self.recorder.note_frame(doc_id, data)
         try:
             kind, value, _ = codec.decode_frame(data)
         except CodecError as e:
+            self._trace_codec_reject(doc_id, e)
             raise self.admission.reject_frame(str(e)) from None
         self.counters.incr("frames_received")
 
@@ -325,7 +330,24 @@ class ShardRouter:
             if marks == mine and digest != state_digest(doc.oracle):
                 doc.divergence_detected = True
                 self.counters.incr("divergence_detected")
+                if self.tracer is not None:
+                    self.tracer.event("divergence", doc=doc_id,
+                                      via="digest")
+                if self.recorder is not None:
+                    self.recorder.on_failure(
+                        "divergence",
+                        "peer digest mismatch at equal watermarks",
+                        doc_id=doc_id, oracle=doc.oracle)
         return []
+
+    def _trace_codec_reject(self, doc_id: Optional[str],
+                            err: CodecError) -> None:
+        """One trace event + (bounded) post-mortem bundle per codec
+        rejection — the 'what came off the wire right before' record."""
+        if self.tracer is not None:
+            self.tracer.event("codec.reject", doc=doc_id, err=str(err))
+        if self.recorder is not None:
+            self.recorder.on_failure("codec", str(err), doc_id=doc_id)
 
     def submit_mux_frame(self, data: bytes) -> List[Tuple[str, str]]:
         """Ingest one doc-multiplexed TXNS frame (``net/columnar``
@@ -338,9 +360,12 @@ class ShardRouter:
         rejections as ``(doc_id, reason)`` pairs (the frame itself
         failing to decode still raises, as in ``submit_frame``)."""
         self.counters.incr("wire_bytes_in", len(data))
+        if self.recorder is not None:
+            self.recorder.note_frame(None, data)
         try:
             kind, groups, _ = codec.decode_frame(data)
         except CodecError as e:
+            self._trace_codec_reject(None, e)
             raise self.admission.reject_frame(str(e)) from None
         if kind != codec.KIND_TXNS_MUX:
             raise self.admission.reject_frame(
@@ -383,6 +408,8 @@ class ShardRouter:
         if not wants:
             return None
         self.counters.incr("range_requests")
+        if self.tracer is not None:
+            self.tracer.event("resync.round", doc=doc_id, wants=len(wants))
         return codec.encode_request(wants)
 
     def export_since(self, doc_id: str, start_order: int

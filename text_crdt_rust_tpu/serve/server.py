@@ -19,11 +19,15 @@ replica that saw the same ops cleanly.
 """
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional
 
 from ..common import RemoteTxn
 from ..config import ServeConfig
 from ..models.sync import state_digest
+from ..obs.recorder import FlightRecorder
+from ..obs.registry import MetricsRegistry
+from ..obs.trace import Tracer
 from ..utils.metrics import Counters, percentiles
 from .admission import AdmissionControl
 from .batcher import ContinuousBatcher, make_lane_backend
@@ -41,17 +45,25 @@ class DocServer:
             f"max_txn_len {cfg.max_txn_len} exceeds the largest step "
             f"bucket {cfg.step_buckets[-1]}: an admitted event could "
             f"never fit a tick")
-        self.counters = counters if counters is not None else Counters()
+        # One metrics registry for the whole server (counters + gauges +
+        # bounded histograms, ISSUE 8) — a caller-supplied plain
+        # Counters still works (histograms degrade to mean gauges).
+        self.counters = (counters if counters is not None
+                         else MetricsRegistry())
+        self.tracer = Tracer(enabled=cfg.trace, ring=cfg.trace_ring,
+                             keep_all=cfg.trace_keep, path=cfg.trace_path)
         self.admission = AdmissionControl(
             max_queue_per_doc=cfg.max_queue_per_doc,
             max_queue_global=cfg.max_queue_global,
             max_txn_len=cfg.max_txn_len,
             rate_capacity=cfg.rate_capacity,
             rate_refill=cfg.rate_refill,
-            counters=self.counters)
+            counters=self.counters,
+            tracer=self.tracer)
         self.router = ShardRouter(cfg.num_shards, admission=self.admission,
                                   counters=self.counters,
-                                  wire_format=cfg.wire_format)
+                                  wire_format=cfg.wire_format,
+                                  tracer=self.tracer)
         backends = [
             make_lane_backend(cfg.engine, lanes=cfg.lanes_per_shard,
                               capacity=cfg.lane_capacity,
@@ -66,14 +78,28 @@ class DocServer:
                                        counters=self.counters,
                                        ckpt_format=cfg.ckpt_format,
                                        ckpt_compact_ops=cfg.ckpt_compact_ops,
-                                       ckpt_compact_links=cfg.ckpt_compact_links)
+                                       ckpt_compact_links=cfg.ckpt_compact_links,
+                                       tracer=self.tracer)
+        # Flight recorder: bundles land in cfg.obs_dir, else the
+        # TCR_TRACE_DIR env knob (how a failing tier-1 serve test
+        # attaches its post-mortem to the pytest report — conftest),
+        # else next to the eviction spool.
+        obs_dir = (cfg.obs_dir or os.environ.get("TCR_TRACE_DIR")
+                   or os.path.join(self.residency.spool_dir, "obs"))
+        self.recorder = FlightRecorder(self.tracer, self.counters, obs_dir,
+                                       ring_events=cfg.trace_ring)
+        self.router.recorder = self.recorder
+        self.residency.recorder = self.recorder
         self.batcher = ContinuousBatcher(self.router, self.residency,
                                          step_buckets=cfg.step_buckets,
                                          lmax=cfg.lmax,
                                          counters=self.counters,
                                          fuse_steps=cfg.fuse_steps,
-                                         fuse_w=cfg.fuse_w)
+                                         fuse_w=cfg.fuse_w,
+                                         tracer=self.tracer,
+                                         recorder=self.recorder)
         self.tick_no = 0
+        self._profiling = False
 
     # -- traffic surface ----------------------------------------------------
 
@@ -106,7 +132,56 @@ class DocServer:
     def tick(self) -> Dict[str, float]:
         self.tick_no += 1
         self.router.set_tick(self.tick_no)
+        self._profile_hook()
         return self.batcher.tick(self.tick_no)
+
+    def close_obs(self) -> None:
+        """Finalize observability at end of run: stop a still-running
+        profiler capture (a run shorter than ``profile_ticks`` would
+        otherwise never write its trace — and leave the process-global
+        profiler running into the next server) and close the trace
+        file. Idempotent; drivers (loadgen, probes) call it on
+        teardown."""
+        if self._profiling:
+            import jax
+
+            try:
+                jax.profiler.stop_trace()
+                self.tracer.event("profile", action="stop",
+                                  dir=self.cfg.profile_dir)
+            except Exception as e:
+                self.counters.incr("profile_errors")
+                self.tracer.event("profile", action="error",
+                                  err=f"{type(e).__name__}: {e}")
+            self._profiling = False
+        self.tracer.close()
+
+    def _profile_hook(self) -> None:
+        """Opt-in ``jax.profiler`` capture (ISSUE 8 device hooks): trace
+        ticks 1..profile_ticks into ``cfg.profile_dir``. Failure to
+        start a profiler (unsupported backend) is counted, never
+        raised — profiling must not take the serving loop down."""
+        if not self.cfg.profile_dir:
+            return
+        import jax
+
+        try:
+            if self.tick_no == 1 and not self._profiling:
+                jax.profiler.start_trace(self.cfg.profile_dir)
+                self._profiling = True
+                self.tracer.event("profile", action="start",
+                                  dir=self.cfg.profile_dir)
+            elif (self._profiling
+                  and self.tick_no > self.cfg.profile_ticks):
+                jax.profiler.stop_trace()
+                self._profiling = False
+                self.tracer.event("profile", action="stop",
+                                  dir=self.cfg.profile_dir)
+        except Exception as e:
+            self._profiling = False
+            self.counters.incr("profile_errors")
+            self.tracer.event("profile", action="error",
+                              err=f"{type(e).__name__}: {e}")
 
     def drain(self, max_ticks: int = 64) -> int:
         """Tick until every queue is empty (or the budget runs out);
@@ -172,13 +247,24 @@ class DocServer:
             if n:
                 out[f"fuse_{shape}"] = n
         # Bytes-on-wire + checkpoint-bytes (ISSUE 7): what the columnar
-        # wire and delta checkpoints are cutting, by lane.
+        # wire and delta checkpoints are cutting, by lane.  Plus the
+        # ISSUE-8 distribution keys: per-stream ops_per_step and
+        # fused_rows_saved histograms (the mean alone hid the PR-6
+        # skew) and per-bucket device-step wall percentiles, all from
+        # the one metrics registry.
         c = self.counters.summary()
         for key in ("wire_bytes_in", "wire_txn_bytes_out",
                     "ckpt_bytes_written", "ckpt_saves_full",
                     "ckpt_saves_delta", "ckpt_bytes_per_evict_mean"):
             if key in c:
                 out[key] = c[key]
+        for key in c:
+            if (key.startswith(("ops_per_step_", "fused_rows_saved_",
+                                "device_step_wall_ms_"))
+                    and key.rsplit("_", 1)[-1] in
+                    ("min", "max", "p50", "p99", "count")):
+                out[key] = c[key]
+        out["device_compiles"] = c.get("device_compiles", 0)
         return out
 
     def stats(self) -> Dict[str, float]:

@@ -117,14 +117,19 @@ class Counters:
     instrumentation (`src/alloc.rs:13-50`): cheap increments everywhere,
     one ``summary()`` dump. ``incr`` counts events; ``hiwater`` keeps the
     max of a gauge (e.g. causal-buffer pending size); ``sample`` feeds a
-    running mean (e.g. per-tick batch fill ratio), reported as
-    ``<name>_mean`` with its sample count as ``<name>_samples``.
+    running mean/min/max (e.g. per-tick batch fill ratio), reported as
+    ``<name>_mean``/``<name>_min``/``<name>_max`` with its sample count
+    as ``<name>_samples`` — means alone hid the PR-6 ``ops_per_step``
+    skew, so the extremes now always ride along (ISSUE 8).  For full
+    distributions (percentiles) use ``obs.registry.MetricsRegistry``,
+    which extends this class with bounded histograms.
     """
 
     def __init__(self) -> None:
         self._counts: Dict[str, int] = {}
         self._hiwater: Dict[str, int] = {}
-        self._samples: Dict[str, Tuple[float, int]] = {}
+        # name -> (total, count, min, max)
+        self._samples: Dict[str, Tuple[float, int, float, float]] = {}
 
     def incr(self, name: str, by: int = 1) -> None:
         self._counts[name] = self._counts.get(name, 0) + by
@@ -134,12 +139,25 @@ class Counters:
             self._hiwater[name] = value
 
     def sample(self, name: str, value: float) -> None:
-        total, count = self._samples.get(name, (0.0, 0))
-        self._samples[name] = (total + float(value), count + 1)
+        v = float(value)
+        total, count, vmin, vmax = self._samples.get(
+            name, (0.0, 0, float("inf"), float("-inf")))
+        self._samples[name] = (total + v, count + 1,
+                               min(vmin, v), max(vmax, v))
 
     def mean(self, name: str) -> float:
-        total, count = self._samples.get(name, (0.0, 0))
+        total, count, _vmin, _vmax = self._samples.get(
+            name, (0.0, 0, 0.0, 0.0))
         return total / count if count else 0.0
+
+    def _sample_stats(self, name: str) -> Tuple[float, int, float, float]:
+        """(total, count, min, max) of one sample gauge (zeros when
+        empty) — the registry exporters read through this."""
+        total, count, vmin, vmax = self._samples.get(
+            name, (0.0, 0, 0.0, 0.0))
+        if not count:
+            return 0.0, 0, 0.0, 0.0
+        return total, count, vmin, vmax
 
     def get(self, name: str) -> int:
         return self._counts.get(name, self._hiwater.get(name, 0))
@@ -148,9 +166,12 @@ class Counters:
         out: Dict[str, float] = dict(self._counts)
         for k, v in self._hiwater.items():
             out[k] = v
-        for k, (total, count) in self._samples.items():
+        for k in self._samples:
+            total, count, vmin, vmax = self._sample_stats(k)
             out[f"{k}_mean"] = round(total / count, 6) if count else 0.0
             out[f"{k}_samples"] = count
+            out[f"{k}_min"] = vmin
+            out[f"{k}_max"] = vmax
         return out
 
 
