@@ -126,9 +126,15 @@ def init_devices(max_tries: int = 3):
 # the table into incomparable halves (the scattered-dicts failure mode
 # the obs/ registry exists to end).  Extra per-config fields are fine;
 # the schema pins the shared floor, not the ceiling.
+from text_crdt_rust_tpu.obs.ledger import LEDGER_SCHEMA_VERSION
+
 ROW_SCHEMA_VERSION = 1
 ROW_SCHEMA = {
     "schema_version": (int,),
+    # The cost-ledger schema the row was recorded against (ISSUE 10):
+    # rows and ledger must agree on what the counters MEAN, so
+    # --merge-rows refuses rows stamped by a drifted ledger schema.
+    "ledger_version": (int,),
     "cfg_key": (str,),
     "variant": (str,),
     "config": (str,),
@@ -167,6 +173,11 @@ def validate_row(row: dict) -> None:
         problems.append(
             f"schema_version {row['schema_version']} != "
             f"{ROW_SCHEMA_VERSION} (re-record through this exporter)")
+    if not problems and row["ledger_version"] != LEDGER_SCHEMA_VERSION:
+        problems.append(
+            f"ledger_version {row['ledger_version']} != "
+            f"{LEDGER_SCHEMA_VERSION} (row counters were recorded "
+            f"against a drifted cost-ledger schema; re-record)")
     if problems:
         raise ValueError(
             f"bench row {row.get('config')!r} violates the exporter "
@@ -408,6 +419,7 @@ def make_row(config, engine, n_ops, batch, wall, steps, hbm_bytes,
     measured, measured_note = measured_device_bytes()
     row = {
         "schema_version": ROW_SCHEMA_VERSION,
+        "ledger_version": LEDGER_SCHEMA_VERSION,
         "config": config,
         "engine": engine,
         "metric": "crdt_ops_per_sec_chip",
@@ -1538,6 +1550,61 @@ def cfg_kevin(args):
     return [cpu_row, tpu_row]
 
 
+# ---------------------------------------------------------- ledger gate --
+
+
+def run_ledger_check(args) -> int:
+    """``--check-ledger`` (ISSUE 10): re-derive the committed cost
+    ledger's cpu cells at their pinned shapes and fail with a NAMED
+    per-metric diff on drift.  Wall-clock-free: every gated metric is a
+    logical counter (same-seed deterministic) or a banded static-HLO
+    cost, so this runs on any CPU box — the tier-1 suite runs it, which
+    means CPU CI guards TPU-relevant cost invariants on every PR."""
+    from text_crdt_rust_tpu.obs.ledger import (
+        cpu_cell_names,
+        diff_ledger,
+        load_ledger,
+        validate_ledger,
+    )
+
+    # The probe owns the derivations (and the sp cell's virtual-mesh
+    # XLA_FLAGS setup, applied at import before the CPU client exists).
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "perf"))
+    import cost_ledger_probe as probe
+
+    committed = load_ledger(args.ledger)
+    validate_ledger(committed)
+    cheap = cpu_cell_names(committed)
+    want = args.cells.split(",") if args.cells else cheap
+    not_cpu = [c for c in want if c not in cheap]
+    if not_cpu:
+        log(f"--check-ledger refused: cells {not_cpu} are not cpu "
+            f"cells of {args.ledger} (device cells need silicon — "
+            f"perf/when_up_r10.sh re-records them)")
+        return 2
+    # A committed cpu cell the probe no longer knows IS drift (a cell
+    # rename/removal without a re-record) — report it as a named
+    # finding, don't crash on the derive call.
+    diffs = [f"{c}: committed as a cpu cell but the probe no longer "
+             f"derives it (re-record perf/COST_LEDGER.json)"
+             for c in want if c not in probe.CPU_CELLS]
+    fresh = probe.derive_cells([c for c in want if c in probe.CPU_CELLS])
+    ok, cell_diffs = diff_ledger(committed, fresh)
+    diffs.extend(cell_diffs)
+    ok = not diffs
+    for d in diffs:
+        log(f"LEDGER DRIFT: {d}")
+    n_metrics = sum(len(c["metrics"]) for c in fresh.values())
+    if ok:
+        log(f"cost ledger OK: {len(fresh)} cells / {n_metrics} metrics "
+            f"re-derived bit-for-logical-bit against {args.ledger}")
+    print(json.dumps({"ledger_ok": ok, "ledger": args.ledger,
+                      "cells_checked": sorted(fresh),
+                      "metrics_checked": n_metrics, "diffs": diffs}))
+    return 0 if ok else 1
+
+
 # ------------------------------------------------------------------ main --
 
 
@@ -1596,7 +1663,23 @@ def main() -> None:
                     help="with --config all: keep clean rows already in "
                          "--out, re-run only missing/error configs")
     ap.add_argument("--out", default="BENCH_ALL.json")
+    ap.add_argument("--check-ledger", action="store_true",
+                    help="re-derive the committed cost ledger's cpu "
+                         "cells (perf/COST_LEDGER.json) and exit "
+                         "nonzero with named per-metric diffs on drift "
+                         "— the wall-clock-free perf regression gate")
+    ap.add_argument("--ledger", default="perf/COST_LEDGER.json",
+                    help="ledger artifact for --check-ledger")
+    ap.add_argument("--cells", default=None,
+                    help="with --check-ledger: comma-separated cell "
+                         "subset (default: every cpu cell)")
     args = ap.parse_args()
+
+    if args.check_ledger:
+        # CPU-only by construction (the whole point); never probes the
+        # device backend.
+        jax.config.update("jax_platforms", "cpu")
+        raise SystemExit(run_ledger_check(args))
 
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,503 @@
+"""Deterministic cost-ledger probe (ISSUE 10 tentpole, part 2).
+
+Derives the ``perf/COST_LEDGER.json`` cells at small PINNED
+deterministic shapes and (record mode) commits them.  Every cpu-cell
+metric is a pure function of the seeded workload — the same
+logical-first discipline that makes two same-seed loadgen runs emit
+byte-identical traces (PERF.md §14) — so ``bench.py --check-ledger``
+can re-derive the cells on any box, wall-clock-free, and fail with a
+named per-metric diff on drift.
+
+Cells (kind ``cpu`` — the tier-1 gate re-derives all of them):
+
+- ``serve``        — the small seeded flat-engine loadgen (the
+  `test_obs_trace.small_loadgen_run` shape): device steps pre/post
+  fusion, recompiles, wire bytes by lane + bytes/op, checkpoint bytes
+  per evict kind, admission/codec rejects, trace volume — PLUS the
+  static compiled-HLO cost of the flat serve kernel at every step
+  bucket (flops / bytes accessed via ``lower().compile()
+  .cost_analysis()``, collectives asserted 0 on the single-shard
+  serve), generalizing the ``sp`` 124-collectives count to the serve
+  engine×bucket grid;
+- ``serve-lanes``  — the SAME seeded tick trace replayed through the
+  kernel-exact blocked-lanes cost model (``perf/blocked_lanes_sim``):
+  touched rows/step blocked vs flat, pass traffic, splits, hint
+  misses — the O(NB+K) contract as a committed number (the real
+  lanes-backend run costs ~90 s of pallas-interpret compile, so the
+  gate replays the flat run's bit-identical compiled streams instead;
+  `perf/serve_lanes_r7.json` holds the full-scale proof);
+- ``fused-trace``  — ``ops.batch.fuse_steps`` over a pinned
+  automerge-paper prefix compiled at the serve lmax: steps in/out,
+  rows saved, per-shape fusion counts;
+- ``sp``           — the sequence-parallel engine's static ICI cost
+  model at a tiny pinned shape: collectives/step by kind off the
+  compiled HLO (the 124 = 94 all-reduce + 30 all-gather invariant),
+  flops/bytes banded.
+
+``--device`` (perf/when_up_r10.sh) appends the silicon cells — wall
+histograms + real-HLO costs on the default backend — without touching
+the cpu cells; the gate skips ``kind: device`` cells on CPU.
+
+Run:  python perf/cost_ledger_probe.py [--out perf/COST_LEDGER.json]
+                                       [--cells a,b] [--device]
+Check: python bench.py --check-ledger
+"""
+import argparse
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# The sp cell's virtual mesh needs the host-device count baked in
+# before the CPU client initializes (the sp_bench pattern).
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+from text_crdt_rust_tpu.obs.ledger import (  # noqa: E402
+    LEDGER_PATH,
+    LEDGER_SCHEMA_VERSION,
+    metric,
+    validate_ledger,
+)
+
+# -- pinned workload shapes ---------------------------------------------------
+# Changing ANY of these is a ledger re-record, not a tweak: the
+# committed values are only comparable at these exact shapes.
+
+SEED = 7
+SMALL_LOADGEN = dict(docs=6, agents_per_doc=2, ticks=6,
+                     events_per_tick=12, zipf_alpha=1.1, fault_rate=0.10,
+                     local_prob=0.25, seed=SEED)
+SERVE_SHAPE = dict(num_shards=1, lanes_per_shard=4)
+FUSED_TRACE = "automerge-paper"
+FUSED_PATCHES = 4000
+FUSED_LMAX = 8     # the ServeConfig default — serve-shaped streams
+FUSED_W = 8
+SP_PATCHES = 120
+SP_SHARD_ROWS = 64
+HLO_BUCKETS = (8, 32)   # ServeConfig.step_buckets prefix (128 adds ~s
+#                         of compile for no extra information)
+HLO_TOL = 0.5           # HLO costs drift with compiler versions
+WALL_TOL = 1.0          # device-cell wall bands (informational)
+
+_COLLECTIVE_RE = re.compile(
+    r"all-gather|all_gather|all-reduce|all_reduce|collective-permute|"
+    r"collective_permute|all-to-all|all_to_all", re.IGNORECASE)
+
+CPU_CELLS = ("serve", "serve-lanes", "fused-trace", "sp")
+
+
+def _force_cpu():
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass  # in-process import after backend init (tier-1 harness)
+
+
+def _hlo_cost(lowered) -> dict:
+    """(collectives, flops, bytes accessed) of one lowered computation
+    — compiled text for the collective count, ``cost_analysis()`` for
+    flops/bytes (a list of per-computation dicts on some jax versions).
+    """
+    compiled = lowered.compile()
+    try:
+        text = compiled.as_text()
+    except Exception:
+        text = lowered.as_text()
+    hits = _COLLECTIVE_RE.findall(text)
+    kinds = {}
+    for h in hits:
+        k = h.lower().replace("_", "-")
+        kinds[k] = kinds.get(k, 0) + 1
+    ca = compiled.cost_analysis()
+    d = ca[0] if isinstance(ca, list) else (ca or {})
+    return {"collectives": len(hits), "by_kind": kinds,
+            "flops": float(d.get("flops", 0.0)),
+            "bytes": float(d.get("bytes accessed", 0.0))}
+
+
+def _hlo_flat_metrics(platform_note: str = "cpu") -> dict:
+    """Static compiled-HLO cost of the flat serve kernel at each step
+    bucket (lanes/capacities pinned to SERVE_SHAPE's backend)."""
+    from text_crdt_rust_tpu.ops import batch as B
+    from text_crdt_rust_tpu.ops import flat as F
+    from text_crdt_rust_tpu.serve.batcher import FlatLaneBackend
+
+    backend = FlatLaneBackend(lanes=SERVE_SHAPE["lanes_per_shard"],
+                              capacity=512, order_capacity=1536, lmax=8)
+    out = {}
+    for s_bkt in HLO_BUCKETS:
+        stacked = B.stack_ops(
+            [B.pad_ops(B.empty_ops(8), s_bkt)
+             for _ in range(backend.lanes)])
+        lowered = F._apply_ops_batch.lower(backend.docs, stacked,
+                                           local_only=False)
+        cost = _hlo_cost(lowered)
+        out[f"hlo_flat_b{s_bkt}_flops"] = metric(
+            cost["flops"], "hlo", tol=HLO_TOL)
+        out[f"hlo_flat_b{s_bkt}_bytes"] = metric(
+            cost["bytes"], "hlo", tol=HLO_TOL)
+        # Single-shard serving must stay collective-free — an exact 0.
+        out[f"hlo_flat_b{s_bkt}_collectives"] = metric(
+            cost["collectives"], "hlo")
+    return out
+
+
+def cell_serve_pair():
+    """ONE seeded small loadgen run feeding two cells: the ``serve``
+    logical-cost cell (from the server's registry + the loadgen report)
+    and the ``serve-lanes`` touched-rows cell (the run's compiled tick
+    streams replayed through the kernel-exact blocked cost model, sims
+    re-seeded from the oracle at every residency upload exactly as the
+    device backend is)."""
+    import blocked_lanes_sim as BLS
+
+    from text_crdt_rust_tpu.config import ServeConfig, lane_block_geometry
+    from text_crdt_rust_tpu.serve.loadgen import ServeLoadGen
+
+    base = ServeConfig()
+    K = base.lanes_block_k
+    cap_runs, NB, NBT = lane_block_geometry(base.lane_capacity, K)
+    OCAP = base.order_capacity
+
+    cfg = ServeConfig(engine="flat", **SERVE_SHAPE)
+    gen = ServeLoadGen(cfg=cfg, **SMALL_LOADGEN)
+
+    c = BLS.Counter()
+    unb = BLS.UnblockedCost(base.lane_capacity)
+    sims = {}
+
+    def tap(doc_id, ops):
+        sim = sims.get(doc_id)
+        if sim is None:
+            sim = sims[doc_id] = BLS.BlockedLaneSim(K, cap_runs, c, OCAP)
+        BLS._replay_stream(sim, unb, c, ops)
+
+    gen.server.batcher.step_trace = tap
+    res = gen.server.residency
+    for si, backend in enumerate(res.backends):
+        def wrap(orig, si):
+            def upload(b, oracle, ranks):
+                doc_id = res.lane_owner[si][b]
+                sim = sims.get(doc_id)
+                if sim is None:
+                    sim = sims[doc_id] = BLS.BlockedLaneSim(
+                        K, cap_runs, c, OCAP)
+                BLS._seed_sim_from_oracle(sim, oracle)
+                orig(b, oracle, ranks)
+            return upload
+        backend.upload_lane = wrap(backend.upload_lane, si)
+
+    rep = gen.run()
+    assert rep["converged"], rep["mismatches"][:4]
+
+    tick = rep["tick_ms"]
+    srv = rep["server"]
+    wire = rep["wire"]
+
+    m = {
+        # steps: the device-step economy of the tick loop.
+        "item_ops_applied": metric(rep["item_ops_applied"], "steps"),
+        "steps_total": metric(tick["steps_total"], "steps"),
+        "steps_prefuse": metric(tick["steps_prefuse"], "steps"),
+        "fused_rows_saved": metric(tick["fused_rows_saved"], "steps"),
+        "device_ticks": metric(srv.get("device_ticks", 0), "steps"),
+        "device_steps_padded": metric(srv.get("device_steps", 0),
+                                      "steps"),
+        # compile: steady state must cycle a fixed kernel set.
+        "device_compiles": metric(srv.get("device_compiles", 0),
+                                  "compile"),
+        # wire: the replication byte bill by lane.
+        "wire_push_bytes": metric(wire["push_bytes"], "wire"),
+        "wire_pull_bytes": metric(wire["pull_bytes"], "wire"),
+        "wire_ctrl_bytes": metric(wire["ctrl_bytes"], "wire"),
+        "wire_txn_bytes": metric(wire["txn_bytes"], "wire"),
+        "ops_replicated": metric(wire["ops_replicated"], "wire"),
+        "bytes_per_op": metric(wire["bytes_per_op"], "wire"),
+        # ckpt: eviction residency costs by kind.
+        "evictions": metric(srv.get("evictions", 0), "ckpt"),
+        "restores": metric(srv.get("restores", 0), "ckpt"),
+        "ckpt_bytes_written": metric(srv.get("ckpt_bytes_written", 0),
+                                     "ckpt"),
+        "ckpt_saves_delta": metric(srv.get("ckpt_saves_delta", 0),
+                                   "ckpt"),
+        "ckpt_saves_full": metric(srv.get("ckpt_saves_full", 0), "ckpt"),
+        "ckpt_bytes_per_evict_mean": metric(
+            srv.get("ckpt_bytes_per_evict_mean", 0.0), "ckpt"),
+        # admission: typed-refusal economy under 10% faults.
+        "admitted": metric(srv.get("admitted", 0), "admission"),
+        "admitted_items": metric(srv.get("admitted_items", 0),
+                                 "admission"),
+        "rejected_frame_rejected": metric(
+            srv.get("rejected_frame_rejected", 0), "admission"),
+        "codec_failures": metric(srv.get("obs_failures_codec", 0),
+                                 "admission"),
+        # trace: event volume + bundle economy (bounded by design).
+        "trace_events": metric(rep["obs"]["trace_events"], "trace"),
+        "bundles_written": metric(rep["obs"]["bundles_written"],
+                                  "trace"),
+        "bundles_suppressed": metric(rep["obs"]["bundles_suppressed"],
+                                     "trace"),
+    }
+    # fuse: per-shape counters the tick fusion produced (stable keys —
+    # the run is seeded, so the set of nonzero shapes is pinned too).
+    for k in sorted(tick):
+        if k.startswith("fuse_"):
+            m[k] = metric(tick[k], "fuse")
+    m.update(_hlo_flat_metrics())
+
+    serve_cell = {
+        "kind": "cpu",
+        "workload": {**SMALL_LOADGEN, **SERVE_SHAPE, "engine": "flat",
+                     "wire": cfg.wire_format, "ckpt": cfg.ckpt_format,
+                     "hlo_buckets": list(HLO_BUCKETS),
+                     "hlo_lanes": SERVE_SHAPE["lanes_per_shard"]},
+        "metrics": m,
+    }
+
+    steps = max(c.steps, 1)
+    lanes_cell = {
+        "kind": "cpu",
+        "workload": {**SMALL_LOADGEN, **SERVE_SHAPE,
+                     "block_k": K, "lane_capacity_runs": cap_runs,
+                     "NBT": NBT, "order_capacity": OCAP,
+                     "source": "flat-backend tick trace (bit-identical "
+                               "streams; lanes-backend re-derivation is "
+                               "the ~90s pallas-interpret path — "
+                               "perf/serve_lanes_r7.json holds it at "
+                               "full scale)"},
+        "metrics": {
+            "trace_steps": metric(c.steps, "touched-rows"),
+            "splits": metric(c.splits, "touched-rows"),
+            "hint_misses": metric(c.hint_misses, "touched-rows"),
+            "hint_probes": metric(c.hint_probes, "touched-rows"),
+            "touched_rows_per_step_flat": metric(
+                round(c.unb_touched / steps, 1), "touched-rows"),
+            "touched_rows_per_step_blocked": metric(
+                round(c.blk_touched / steps, 1), "touched-rows"),
+            "touched_rows_ratio": metric(
+                round(c.unb_touched / max(c.blk_touched, 1), 2),
+                "touched-rows"),
+            "pass_traffic_per_step_flat": metric(
+                round(c.unb_traffic / steps, 1), "touched-rows"),
+            "pass_traffic_per_step_blocked": metric(
+                round(c.blk_traffic / steps, 1), "touched-rows"),
+            "pass_traffic_ratio": metric(
+                round(c.unb_traffic / max(c.blk_traffic, 1), 2),
+                "touched-rows"),
+        },
+    }
+    return serve_cell, lanes_cell
+
+
+def cell_fused_trace():
+    """Generalized step fusion over a pinned real-trace prefix compiled
+    at the serve lmax — the ISSUE-6 step economy as exact counters."""
+    from text_crdt_rust_tpu.ops import batch as B
+    from text_crdt_rust_tpu.utils.testdata import (
+        flatten_patches,
+        load_testing_data,
+        trace_path,
+    )
+
+    patches = flatten_patches(
+        load_testing_data(trace_path(FUSED_TRACE)))[:FUSED_PATCHES]
+    ops, _ = B.compile_local_patches(patches, lmax=FUSED_LMAX, dmax=None)
+    _fused, fs = B.fuse_steps(ops, fuse_w=FUSED_W)
+    m = {
+        "steps_prefuse": metric(fs.steps_in, "fuse"),
+        "steps_fused": metric(fs.steps_out, "fuse"),
+        "rows_saved": metric(fs.rows_saved, "fuse"),
+        "reduction_x": metric(round(fs.reduction_x, 3), "fuse"),
+    }
+    for shape, n in sorted(fs.fused.items()):
+        m[f"fuse_{shape}"] = metric(n, "fuse")
+    return {
+        "kind": "cpu",
+        "workload": {"trace": FUSED_TRACE, "patches": FUSED_PATCHES,
+                     "lmax": FUSED_LMAX, "fuse_w": FUSED_W},
+        "metrics": m,
+    }
+
+
+def cell_sp():
+    """The sequence-parallel engine's static ICI cost model at a tiny
+    pinned shape: collectives/step by kind off the compiled HLO (scan
+    body emitted once -> textual occurrences = per-step cost)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from text_crdt_rust_tpu.ops import batch as B
+    from text_crdt_rust_tpu.parallel import make_mesh
+    from text_crdt_rust_tpu.parallel.sp_apply import SpDoc
+    from text_crdt_rust_tpu.utils.testdata import (
+        flatten_patches,
+        load_testing_data,
+        trace_path,
+    )
+
+    patches = flatten_patches(
+        load_testing_data(trace_path("automerge-paper")))[:SP_PATCHES]
+    merged = B.merge_patches(patches)
+    lmax = max([len(p.ins_content) for p in merged] + [1])
+    ops, _ = B.compile_local_patches(merged, lmax=lmax, dmax=None)
+    mesh = make_mesh(n_devices=8, dp=1, sp=8)
+    sdoc = SpDoc(mesh, shard_rows=SP_SHARD_ROWS, order_rows=64,
+                 auto_reshard=True)
+    cols = tuple(
+        jnp.asarray(np.asarray(col, dtype=np.uint32).view(np.int32))
+        for col in (ops.kind, ops.pos, ops.del_len, ops.del_target,
+                    ops.origin_left, ops.origin_right, ops.rank,
+                    ops.ins_len, ops.ins_order_start))
+    lowered = sdoc._replay.lower(sdoc.ordp, sdoc.lenp, sdoc.rows,
+                                 sdoc.oll, sdoc.orl, sdoc.rkl, *cols)
+    cost = _hlo_cost(lowered)
+    m = {
+        "steps": metric(ops.num_steps, "steps"),
+        "collectives_per_step": metric(cost["collectives"], "hlo"),
+        "hlo_flops": metric(cost["flops"], "hlo", tol=HLO_TOL),
+        "hlo_bytes": metric(cost["bytes"], "hlo", tol=HLO_TOL),
+    }
+    for kind, n in sorted(cost["by_kind"].items()):
+        m[f"collectives_{kind.replace('-', '_')}"] = metric(n, "hlo")
+    return {
+        "kind": "cpu",
+        "workload": {"trace": "automerge-paper", "patches": SP_PATCHES,
+                     "sp": 8, "shard_rows": SP_SHARD_ROWS,
+                     "order_rows": 64},
+        "metrics": m,
+    }
+
+
+def cell_serve_device():
+    """Silicon cell (perf/when_up_r10.sh): the same small loadgen on
+    the DEFAULT jax backend — per-bucket device-step wall histograms
+    plus the real-HLO flat-kernel costs.  Wall metrics carry wide bands
+    (they gate nothing on CPU; the cell is the committed record of what
+    the chip measured)."""
+    import jax
+
+    from text_crdt_rust_tpu.config import ServeConfig
+    from text_crdt_rust_tpu.serve.loadgen import ServeLoadGen
+
+    platform = jax.devices()[0].platform
+    cfg = ServeConfig(engine="flat", **SERVE_SHAPE)
+    gen = ServeLoadGen(cfg=cfg, **SMALL_LOADGEN)
+    rep = gen.run()
+    assert rep["converged"], rep["mismatches"][:4]
+    srv = rep["server"]
+    m = {}
+    for key in sorted(srv):
+        if key.startswith("device_step_wall_ms_b") and key.rsplit(
+                "_", 1)[-1] in ("mean", "p50", "p99"):
+            m[key] = metric(srv[key], "wall", tol=WALL_TOL)
+    m["tick_wall_ms_p50"] = metric(srv.get("tick_wall_ms_p50", 0.0),
+                                   "wall", tol=WALL_TOL)
+    m["tick_wall_ms_p99"] = metric(srv.get("tick_wall_ms_p99", 0.0),
+                                   "wall", tol=WALL_TOL)
+    for name, entry in _hlo_flat_metrics(platform).items():
+        m[f"device_{name}"] = entry
+    return {
+        "kind": "device",
+        "workload": {**SMALL_LOADGEN, **SERVE_SHAPE, "engine": "flat",
+                     "platform": platform},
+        "metrics": m,
+    }
+
+
+def derive_cells(names=None) -> dict:
+    """Derive the named cpu cells (all of them by default).  ``serve``
+    and ``serve-lanes`` share one loadgen run, so requesting either
+    derives both internally."""
+    names = list(names) if names is not None else list(CPU_CELLS)
+    unknown = [n for n in names if n not in CPU_CELLS]
+    if unknown:
+        raise ValueError(f"unknown ledger cells {unknown}; cpu cells "
+                         f"are {CPU_CELLS}")
+    out = {}
+    if "serve" in names or "serve-lanes" in names:
+        serve_cell, lanes_cell = cell_serve_pair()
+        if "serve" in names:
+            out["serve"] = serve_cell
+        if "serve-lanes" in names:
+            out["serve-lanes"] = lanes_cell
+    if "fused-trace" in names:
+        out["fused-trace"] = cell_fused_trace()
+    if "sp" in names:
+        out["sp"] = cell_sp()
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default=LEDGER_PATH)
+    ap.add_argument("--cells", default=None,
+                    help="comma-separated cell subset (default: all "
+                         "cpu cells)")
+    ap.add_argument("--device", action="store_true",
+                    help="derive the SILICON cells on the default jax "
+                         "backend and merge them into --out, keeping "
+                         "the committed cpu cells")
+    a = ap.parse_args()
+
+    import jax
+
+    if a.device:
+        cells = {"serve-device": cell_serve_device()}
+        with open(a.out) as f:
+            ledger = json.load(f)
+        ledger["cells"].update(cells)
+        ledger.setdefault("recorded", {})["device"] = {
+            "jax": jax.__version__,
+            "platform": jax.devices()[0].platform,
+        }
+    else:
+        _force_cpu()
+        want = a.cells.split(",") if a.cells else None
+        cells = derive_cells(want)
+        prior = {}
+        if os.path.exists(a.out):
+            with open(a.out) as f:
+                prior = json.load(f)
+        # A cpu re-record NEVER erases silicon work: prior device cells
+        # (and their provenance) always survive.  A full re-record
+        # supersedes every cpu cell (stale renamed cells drop); a
+        # --cells partial keeps the cpu cells it didn't re-derive.
+        merged = {n: c for n, c in prior.get("cells", {}).items()
+                  if c.get("kind") == "device" or (want and n not in
+                                                   cells)}
+        merged.update(cells)
+        recorded = dict(prior.get("recorded", {}))
+        recorded.update({
+            "probe": "perf/cost_ledger_probe.py",
+            "jax": jax.__version__,
+            "note": "cpu cells are exact logical counters (same-"
+                    "seed deterministic, PERF.md §14) except hlo "
+                    "metrics, which carry relative tolerance "
+                    "bands; re-derive with bench.py --check-ledger",
+        })
+        ledger = {
+            "schema_version": LEDGER_SCHEMA_VERSION,
+            "recorded": recorded,
+            "cells": merged,
+        }
+    validate_ledger(ledger)
+    with open(a.out, "w") as f:
+        json.dump(ledger, f, indent=1, sort_keys=True)
+        f.write("\n")
+    n_metrics = sum(len(c["metrics"]) for c in cells.values())
+    print(f"recorded {len(cells)} cell(s) / {n_metrics} metrics "
+          f"into {a.out}", file=sys.stderr)
+    print(json.dumps({"cells": sorted(ledger["cells"]),
+                      "metrics": n_metrics}))
+
+
+if __name__ == "__main__":
+    main()

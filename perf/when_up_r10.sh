@@ -1,0 +1,68 @@
+#!/bin/bash
+# Round-10 recovery watcher (ISSUE 10 / ROADMAP #1): supersedes
+# when_up_r9.sh and keeps its gate chain — matmul tunnel probe ->
+# compile pin -> fused kevin device smoke -> fused serve-lanes loadgen
+# smoke -> kevin full 5M -> the remaining rows via --merge-rows — then
+# adds the COST LEDGER device re-record: after the bench rows land,
+# perf/cost_ledger_probe.py --device appends the silicon cells (per-
+# bucket device-step wall histograms + real-HLO flat-kernel costs on
+# the chip) to perf/COST_LEDGER.json WITHOUT touching the committed
+# cpu cells, and bench.py --check-ledger re-runs once at the end so a
+# drifted cpu cell is caught in the same session that recorded silicon
+# (every row merged here is stamped ledger_version — a drifted ledger
+# schema refuses the merge).  Safe to re-run; appends to
+# perf/when_up_r10.log.
+set -u
+cd /root/repo
+while true; do
+  if timeout 240 python -c "
+import jax, numpy as np, jax.numpy as jnp
+x = jnp.ones((128,128), jnp.bfloat16)
+assert float(np.asarray(x @ x)[0,0]) == 128.0
+" >/dev/null 2>&1; then
+    echo "$(date -u +%H:%M:%S) tunnel is back (r10 watcher)" >> perf/when_up_r10.log
+    break
+  fi
+  echo "$(date -u +%H:%M:%S) still down (r10)" >> perf/when_up_r10.log
+  sleep 120
+done
+timeout 2400 python perf/compile_pin.py >> perf/compile_pin_r10.log 2>&1 \
+  || echo "PIN FAILED/TIMED OUT rc=$? - investigate before trusting bench" \
+       >> perf/compile_pin_r10.log
+# Fused-kernel device smoke first: a tiny fused kevin (2048 prepends,
+# W=8) proves the W-row splice compiles on real Mosaic before
+# committing to the 40-min full run.
+timeout 1800 python bench.py --config kevin --smoke --no-probe \
+  >> perf/when_up_r10.log 2>&1 \
+  || { echo "fused kevin device smoke FAILED rc=$? - NOT re-recording" \
+         >> perf/when_up_r10.log; exit 1; }
+# Second gate: a fused serve-lanes loadgen smoke — the blocked mixed
+# kernel's fused splice + the serve stack's fused ticks on device.
+timeout 1800 python -m text_crdt_rust_tpu.serve.loadgen --device \
+  --docs 24 --ticks 10 --engine rle-lanes-mixed \
+  >> perf/when_up_r10.log 2>&1 \
+  || { echo "fused serve-lanes device smoke FAILED rc=$? - NOT re-recording" \
+         >> perf/when_up_r10.log; exit 1; }
+# Headline: kevin at full 5M, fused W=64 (rle-hbm-fused row).
+timeout 7200 python bench.py --config kevin --merge-rows --no-probe \
+  >> perf/bench_kevin_r10.log 2>&1 \
+  || echo "kevin re-record FAILED rc=$?" >> perf/when_up_r10.log
+# Remaining rows, most verdict-critical first; every merged row is
+# ledger_version-stamped by the exporter.
+for cfg in northstar 4 5r 5 serve serve-lanes sp; do
+  timeout 7200 python bench.py --config "$cfg" --merge-rows --no-probe \
+    >> "perf/bench_cfg${cfg}_r10.log" 2>&1 \
+    || echo "config $cfg re-record FAILED rc=$?" >> perf/when_up_r10.log
+done
+# NEW in r10: the cost-ledger silicon cells — device-step wall
+# histograms + real-HLO costs on the chip, appended to the committed
+# ledger (cpu cells untouched).
+timeout 3600 python perf/cost_ledger_probe.py --device \
+  >> perf/when_up_r10.log 2>&1 \
+  || echo "ledger device re-record FAILED rc=$?" >> perf/when_up_r10.log
+# And prove the cpu contract still holds from this very checkout.
+timeout 1800 env JAX_PLATFORMS=cpu python bench.py --check-ledger \
+  >> perf/when_up_r10.log 2>&1 \
+  || echo "LEDGER CHECK FAILED rc=$? - cpu cost contract drifted" \
+       >> perf/when_up_r10.log
+echo "$(date -u +%H:%M:%S) r10 re-record done" >> perf/when_up_r10.log

@@ -16,13 +16,15 @@ from bench import (
     merge_config_rows,
     validate_row,
 )
+from text_crdt_rust_tpu.obs.ledger import LEDGER_SCHEMA_VERSION
 
 
 def row(**kw):
     """A schema-complete exporter row with overrides (the
     ``test_bench_rowsink.row`` fixture; tests/ is not a package, so the
     helper is duplicated rather than imported)."""
-    r = {"schema_version": ROW_SCHEMA_VERSION, "config": "cfg",
+    r = {"schema_version": ROW_SCHEMA_VERSION,
+         "ledger_version": LEDGER_SCHEMA_VERSION, "config": "cfg",
          "engine": "rle", "metric": "crdt_ops_per_sec_chip",
          "value": 1.0, "unit": "ops/s", "batch": 1, "ops": 1,
          "device_steps": 1, "mean_step_latency_us": 1.0,
@@ -79,6 +81,26 @@ def test_schema_floor_matches_make_row():
             continue
         assert f'"{field}"' in src, (
             f"ROW_SCHEMA requires {field!r} but make_row never emits it")
+
+
+def test_rows_carry_and_enforce_ledger_version(tmp_path):
+    """ISSUE 10 satellite: rows are stamped with the cost-ledger schema
+    they were recorded against, and ``--merge-rows`` refuses rows from
+    a drifted ledger schema (their counters no longer mean what the
+    committed ledger's do)."""
+    validate_row(row())  # current stamp passes
+    with pytest.raises(ValueError, match="ledger_version"):
+        validate_row(row(ledger_version=LEDGER_SCHEMA_VERSION + 1))
+    with pytest.raises(ValueError, match="missing field 'ledger_version'"):
+        bad = row()
+        del bad["ledger_version"]
+        validate_row(bad)
+    p = str(tmp_path / "all.json")
+    with pytest.raises(ValueError, match="drifted cost-ledger schema"):
+        merge_config_rows(
+            p, "kevin", [row(ledger_version=LEDGER_SCHEMA_VERSION + 1)],
+            "v")
+    assert not os.path.exists(p)  # nothing written
 
 
 def test_merge_rows_refuses_shape_drifted_rows(tmp_path):

@@ -1,0 +1,168 @@
+"""obs/analyze (ISSUE 10): phase breakdown, hot-doc/fusion tables,
+recompile timeline, two-trace logical diff and the Chrome trace-event
+export — all against the COMMITTED trace fixture
+(``tests/data/obs_trace_fixture.jsonl``, a tiny seeded loadgen run)
+and its golden outputs, so any analytics drift shows as a golden diff
+rather than a silent behavior change."""
+import json
+import os
+import subprocess
+import sys
+
+from text_crdt_rust_tpu.obs import analyze as A
+from text_crdt_rust_tpu.obs.trace import WALL_KEY, validate_event
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIXTURE = os.path.join(HERE, "data", "obs_trace_fixture.jsonl")
+FIXTURE_B = os.path.join(HERE, "data", "obs_trace_fixture_b.jsonl")
+GOLDEN = os.path.join(HERE, "data", "obs_trace_fixture_golden.json")
+
+
+def events():
+    return A.load_events([FIXTURE])
+
+
+def golden():
+    with open(GOLDEN) as f:
+        return json.load(f)
+
+
+def test_fixture_is_schema_valid():
+    evs = events()
+    assert evs[0]["k"] == "trace.header"
+    for ev in evs:
+        validate_event(ev)
+    # The fixture exercises every analytics surface.
+    kinds = {e["k"] for e in evs}
+    assert {"apply", "tick.fuse", "device.compile",
+            "tick.device", "tick.barrier"} <= kinds
+
+
+def test_phase_breakdown_matches_golden():
+    d = A.phase_breakdown(events())
+    assert d == golden()["phases"]
+    # Structural floor independent of the golden: all five phases
+    # reported, shares sum to ~100 where wall exists.
+    assert set(d["phases"]) == set(A.PHASES)
+    assert d["ticks"] > 0
+    assert abs(sum(p["share_pct"] for p in d["phases"].values())
+               - 100.0) < 0.5
+
+
+def test_hotdocs_fuse_recompiles_match_golden():
+    g = golden()
+    assert A.hot_docs(events(), top=5) == g["hotdocs"]
+    fuse = A.fusion_table(events(), top=5)
+    assert fuse == g["fuse"]
+    assert fuse["rows_saved"] == fuse["steps_in"] - fuse["steps_out"]
+    rec = A.recompile_timeline(events())
+    assert rec == g["recompiles"]
+    assert rec["compiles"] >= 1
+    # Steady state: the fixture's compiles are all warm-up ticks.
+    assert rec["last_compile_tick"] <= rec["run_last_tick"]
+
+
+def test_two_trace_diff_names_first_diverging_event():
+    a, b = events(), A.load_events([FIXTURE_B])
+    assert A.trace_diff(a, a) is None
+    d = A.trace_diff(a, b)
+    assert d == golden()["diff_vs_b"]
+    assert d["fields"] == ["n"]
+    assert d["a"]["k"] == "apply"
+    assert d["index"] == d["a"]["i"]  # logical seq == stream index here
+
+
+def test_diff_ignores_wall_and_catches_length_drift():
+    a = events()
+    walled = [dict(e) for e in a]
+    for e in walled:
+        e[WALL_KEY] = {"ms": 123.0}  # pure wall noise
+    assert A.trace_diff(a, walled) is None
+    d = A.trace_diff(a, a[:-1])
+    assert d["only_in"] == "a" and d["index"] == len(a) - 1
+
+
+def test_chrome_export_is_valid_trace_event_json(tmp_path):
+    doc = A.chrome_trace(events())
+    # Round-trippable JSON with the trace-event envelope.
+    doc = json.loads(json.dumps(doc))
+    assert isinstance(doc["traceEvents"], list) and doc["traceEvents"]
+    spans = 0
+    for te in doc["traceEvents"]:
+        assert "name" in te and "ph" in te and "pid" in te
+        assert te["ph"] in ("X", "i", "M")
+        if te["ph"] != "M":
+            assert isinstance(te["ts"], (int, float))
+        if te["ph"] == "X":
+            spans += 1
+            assert te["dur"] >= 0
+    assert spans >= 4  # the measured wall spans survived the export
+    # Wall spans sit on the LOGICAL tick axis (tick * pitch).
+    first_span = next(t for t in doc["traceEvents"] if t["ph"] == "X")
+    assert first_span["ts"] >= A.CHROME_TICK_US  # tick 1+
+
+
+def test_load_events_reads_bundles_and_segment_lists(tmp_path):
+    """The same analytics run over flight-recorder bundle JSONs (their
+    ``events`` list is the trace schema) and over rotated segment
+    lists, concatenating in order."""
+    evs = events()
+    bundle = str(tmp_path / "bundle_x.json")
+    with open(bundle, "w") as f:
+        json.dump({"schema_version": 1, "reason": "divergence",
+                   "events": evs[:10]}, f, indent=1)
+    assert A.load_events([bundle]) == evs[:10]
+    # Two "segments" (a split of the fixture) reload as one stream.
+    seg1, seg2 = str(tmp_path / "t.jsonl"), str(tmp_path / "t.jsonl.1")
+    lines = open(FIXTURE).read().splitlines()
+    with open(seg1, "w") as f:
+        f.write("\n".join(lines[:20]) + "\n")
+    with open(seg2, "w") as f:
+        f.write("\n".join(lines[20:]) + "\n")
+    assert A.load_events([seg1, seg2]) == evs
+
+
+def test_load_events_keeps_prefix_of_crash_truncated_segment(tmp_path):
+    """A process dying mid-write leaves a partial final line — exactly
+    the artifact a post-mortem reads.  load_events must return the
+    valid prefix, not refuse the file."""
+    full = open(FIXTURE).read()
+    lines = full.splitlines()
+    trunc = str(tmp_path / "trunc.jsonl")
+    with open(trunc, "w") as f:
+        f.write("\n".join(lines[:30]) + "\n" + lines[30][:17])
+    evs = A.load_events([trunc])
+    assert evs == events()[:30]
+    # Same tolerance mid-file (a flipped byte): valid prefix survives.
+    corrupt = str(tmp_path / "corrupt.jsonl")
+    with open(corrupt, "w") as f:
+        f.write("\n".join(lines[:10]) + "\n{not json}\n"
+                + "\n".join(lines[10:]) + "\n")
+    assert A.load_events([corrupt]) == events()[:10]
+
+
+def test_cli_end_to_end(tmp_path):
+    """The CLI surface: phases + diff (exit 1 on divergence) + chrome
+    file output, one subprocess each on the tiny fixture."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    repo = os.path.dirname(HERE)
+    r = subprocess.run(
+        [sys.executable, "-m", "text_crdt_rust_tpu.obs.analyze",
+         "phases", FIXTURE, "--json"],
+        capture_output=True, text=True, timeout=120, cwd=repo, env=env)
+    assert r.returncode == 0, r.stderr[-800:]
+    assert json.loads(r.stdout) == golden()["phases"]
+    r = subprocess.run(
+        [sys.executable, "-m", "text_crdt_rust_tpu.obs.analyze",
+         "diff", FIXTURE, FIXTURE_B],
+        capture_output=True, text=True, timeout=120, cwd=repo, env=env)
+    assert r.returncode == 1
+    assert "first divergence at event" in r.stdout
+    out = str(tmp_path / "chrome.json")
+    r = subprocess.run(
+        [sys.executable, "-m", "text_crdt_rust_tpu.obs.analyze",
+         "chrome", FIXTURE, "-o", out],
+        capture_output=True, text=True, timeout=120, cwd=repo, env=env)
+    assert r.returncode == 0
+    with open(out) as f:
+        assert json.load(f)["traceEvents"]

@@ -218,6 +218,31 @@ def test_lane_mismatch_dumps_divergence_bundle(tmp_path):
     assert json.load(open(bundles[0]))["doc"] == "d0"
 
 
+def test_tick_summary_surfaces_bundle_counts(tmp_path):
+    """ISSUE 10 satellite: ``DocServer.tick_summary`` carries the
+    flight-recorder bundle economy (written + suppressed) as additive
+    keys, so a summary consumer sees 'this run failed the same way N
+    times' without grepping the obs dir."""
+    srv = small_server(tmp_path)
+    srv.admit_doc("d0")
+    ts = srv.tick_summary()
+    assert ts["bundles_written"] == 0
+    assert ts["bundles_suppressed"] == 0
+    frame = bytearray(codec.encode_txns(peer_history()))
+    frame[len(frame) // 2] ^= 0xFF  # CRC fails -> codec bundle
+    for _ in range(3):
+        with pytest.raises(AdmissionError):
+            srv.submit_frame("d0", bytes(frame))
+    ts = srv.tick_summary()
+    assert ts["bundles_written"] == 1       # first failure dumped
+    assert ts["bundles_suppressed"] == 2    # repeats counted
+    assert ts["bundles_written"] == len(srv.recorder.bundle_paths)
+    # The same keys flow through stats() (the loadgen report's source).
+    st = srv.stats()
+    assert st["tick_ms_bundles_written"] == 1
+    assert st["tick_ms_bundles_suppressed"] == 2
+
+
 def test_bundle_budget_is_per_reason(tmp_path):
     reg = MetricsRegistry()
     rec = FlightRecorder(None, reg, str(tmp_path / "obs"))

@@ -121,6 +121,56 @@ def test_trace_path_streams_jsonl(tmp_path):
     assert len(lines) == 2  # header + event
     assert json.loads(lines[0])["schema"] == TRACE_SCHEMA_VERSION
     assert json.loads(lines[1])["wants"] == 2
+    assert tr.segment_paths == [p]  # no rotation cap -> one segment
+
+
+def test_stream_rotation_preserves_logical_byte_identity(tmp_path):
+    """ISSUE 10 satellite: size-capped segment rollover — the
+    concatenated segments must be BYTE-IDENTICAL (logical projection)
+    to an unrotated same-event stream, and every segment except the
+    last must respect the cap's between-events granularity (rotation
+    never splits a line)."""
+    p = str(tmp_path / "rot.jsonl")
+    rot = Tracer(ring=8, path=p, rotate_bytes=400, keep_all=True)
+    plain = Tracer(ring=8, keep_all=True)
+    for tr in (rot, plain):
+        for i in range(40):
+            tr.set_tick(i // 4)
+            tr.event("apply", doc=f"d{i % 3}", ev="local", agent="a",
+                     seq=i, n=1, wall={"ms": float(i)})
+    rot.close()
+    assert len(rot.segment_paths) > 2  # the cap actually rotated
+    assert rot.segment_paths[0] == p
+    assert rot.segment_paths[1] == p + ".1"
+    # Concatenated segments == the unrotated stream, byte for byte.
+    concat = b"".join(open(s, "rb").read() for s in rot.segment_paths)
+    lines = concat.decode().splitlines()
+    assert [json.loads(ln) for ln in lines] == rot.events
+    # Logical projection across the rollover boundary matches the
+    # in-memory logical stream exactly.
+    logical = "\n".join(
+        event_line(ev, logical_only=True)
+        for ev in (json.loads(ln) for ln in lines)) + "\n"
+    assert logical.encode() == plain.logical_bytes()
+    # Every non-final segment closed at/after the cap, never mid-line.
+    import os
+    for seg in rot.segment_paths[:-1]:
+        assert os.path.getsize(seg) >= 400
+        assert open(seg, "rb").read().endswith(b"\n")
+
+
+def test_loadgen_rotated_segments_reload_via_analyze(tmp_path):
+    """End to end: a rotated server trace reloads through
+    ``obs.analyze.load_events`` as one stream, identical to the
+    tracer's retained events."""
+    from text_crdt_rust_tpu.obs import analyze as A
+
+    p = str(tmp_path / "t.jsonl")
+    gen, _rep = small_loadgen_run(trace_path=p, trace_rotate_bytes=2048)
+    segs = gen.server.tracer.segment_paths
+    assert len(segs) > 1
+    events = A.load_events(segs)
+    assert events == gen.server.tracer.events
 
 
 # -------------------------------------------------------------- registry --
@@ -183,6 +233,67 @@ def test_observe_falls_back_to_sample_on_plain_counters():
     assert reg.histogram("x").count == 1
 
 
+def test_prometheus_text_conformance_edge_cases():
+    """ISSUE 10 satellite: names sanitize (incl. the leading-digit
+    rule), label values escape, every metric gets one # HELP/# TYPE
+    pair, and sanitize collisions don't emit duplicate TYPE lines."""
+    from text_crdt_rust_tpu.obs.registry import (
+        prom_escape_label,
+        prom_name,
+    )
+
+    reg = MetricsRegistry()
+    reg.incr("weird metric-name.v2", 5)    # spaces/dash/dot -> _
+    reg.incr("weird_metric_name_v2", 7)    # collides post-sanitize
+    reg.gauge("9starts_with_digit", 1.5)
+    reg.histo("tick ms", 2.0)
+    text = reg.prometheus_text(prefix="")
+    lines = text.splitlines()
+    # Names conform to [a-zA-Z_:][a-zA-Z0-9_:]*
+    import re
+
+    name_re = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*")
+    for ln in lines:
+        if ln.startswith("#"):
+            assert ln.startswith(("# HELP ", "# TYPE "))
+            assert name_re.fullmatch(ln.split()[2])
+        else:
+            assert name_re.fullmatch(ln.split("{")[0].split()[0]), ln
+    # Leading digit got guarded.
+    assert any(ln.startswith("_9starts_with_digit ") for ln in lines)
+    # Every # TYPE names a DISTINCT metric (the collision was suffixed).
+    typed = [ln.split()[2] for ln in lines if ln.startswith("# TYPE")]
+    assert len(typed) == len(set(typed))
+    # Both colliding counters surfaced with their values.
+    assert any(ln.endswith(" 5") for ln in lines)
+    assert any(ln.endswith(" 7") for ln in lines)
+    # One HELP per TYPE, adjacent.
+    helps = [ln.split()[2] for ln in lines if ln.startswith("# HELP")]
+    assert helps == typed
+    # Label-value escaping helper: the three escape-worthy characters.
+    assert prom_escape_label('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
+    assert prom_name("9x", prefix="") == "_9x"
+    assert prom_name("a b.c", prefix="tcr") == "tcr_a_b_c"
+    # The default-prefix output still parses as before.
+    reg2 = MetricsRegistry()
+    reg2.incr("frames", 3)
+    t2 = reg2.prometheus_text()
+    assert "# TYPE tcr_frames counter" in t2
+    assert "# HELP tcr_frames" in t2
+    assert "tcr_frames 3" in t2
+    # One RAW name reused across metric kinds is a collision too: the
+    # second emission gets a stable per-base ordinal suffix instead of
+    # a duplicate # TYPE block.
+    reg3 = MetricsRegistry()
+    reg3.incr("x", 4)
+    reg3.gauge("x", 2.5)
+    t3 = reg3.prometheus_text()
+    typed3 = [ln.split()[2] for ln in t3.splitlines()
+              if ln.startswith("# TYPE")]
+    assert typed3 == ["tcr_x", "tcr_x_1"]
+    assert "tcr_x 4" in t3 and "tcr_x_1 2.5" in t3
+
+
 def test_counters_sample_min_max_in_summary():
     """ISSUE 8 satellite: ``Counters.sample`` reports min/max alongside
     the mean (means alone hid the PR-6 ops_per_step skew)."""
@@ -208,6 +319,14 @@ def test_loadgen_report_obs_block_and_registry_flow():
     assert rep["obs"]["trace_schema"] == TRACE_SCHEMA_VERSION
     assert rep["obs"]["trace_events"] > 0
     assert rep["obs"]["device_compiles"] >= 1
+    # ISSUE 10 satellite: the recorder's bundle economy is first-class
+    # report surface, and the written-FILE count (bundle_count, from
+    # recorder.bundle_paths) agrees with the registry counter — two
+    # independent sources.
+    assert rep["obs"]["bundle_count"] == rep["obs"]["bundles_written"]
+    assert "bundles_suppressed" in rep["obs"]
+    assert "bundles_written" in rep["tick_ms"]
+    assert "bundles_suppressed" in rep["tick_ms"]
     tick = rep["tick_ms"]
     assert "ops_per_step_p99" in tick and "ops_per_step_max" in tick
     srv = rep["server"]

@@ -239,6 +239,10 @@ class ServeConfig:
     trace_ring: int = 512      # flight-recorder ring: last-N events
     trace_path: Optional[str] = None  # stream every event to this JSONL
     #                            file (logical + segregated wall fields)
+    trace_rotate_bytes: Optional[int] = None  # size-cap per stream
+    #                            segment: the file rolls to <path>.1,
+    #                            <path>.2, ... so a long run never grows
+    #                            one unbounded JSONL (None = no cap)
     trace_keep: bool = False   # retain the full event list in memory
     #                            (the trace-determinism tests read it
     #                            back via Tracer.logical_bytes)

@@ -1,10 +1,11 @@
-"""Structured observability for the serving stack (ISSUE 8).
+"""Structured observability for the serving stack (ISSUEs 8 + 10).
 
-Three layers, each consumable on its own:
+Five layers, each consumable on its own:
 
 - ``obs.trace``    — a logical-clock-first span/event tracer emitting
-                     versioned JSONL with wall-clock fields segregated,
-                     so two same-seed runs produce byte-identical
+                     versioned JSONL (size-capped segment rotation for
+                     long runs) with wall-clock fields segregated, so
+                     two same-seed runs produce byte-identical
                      *logical* traces (the determinism oracle);
 - ``obs.registry`` — one metrics registry (counters + gauges + bounded
                      histograms) with JSONL and Prometheus-text
@@ -15,8 +16,22 @@ Three layers, each consumable on its own:
                      failure or twin/lane bit-identity mismatch, dumps
                      a post-mortem bundle (last-N events, counters,
                      doc stats, the offending tick's compiled-step
-                     metadata, and a first-divergence walk).
+                     metadata, and a first-divergence walk);
+- ``obs.ledger``   — the deterministic cost ledger: logical cost
+                     metrics per config cell, committed as
+                     ``perf/COST_LEDGER.json`` and re-derived by
+                     ``bench.py --check-ledger`` — the wall-clock-free
+                     perf regression gate;
+- ``obs.analyze``  — trace analytics CLI: per-tick phase breakdown,
+                     hot-doc and fusion tables, recompile timeline,
+                     two-trace logical diff, Chrome trace-event export.
 """
+from .ledger import (  # noqa: F401
+    LEDGER_SCHEMA_VERSION,
+    diff_ledger,
+    load_ledger,
+    validate_ledger,
+)
 from .recorder import FlightRecorder  # noqa: F401
 from .registry import Histogram, MetricsRegistry, observe  # noqa: F401
 from .trace import TRACE_SCHEMA_VERSION, Tracer, validate_event  # noqa: F401

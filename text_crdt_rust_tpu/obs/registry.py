@@ -40,6 +40,29 @@ _DEFAULT_CAP = 1024
 _PROM_SANITIZE = re.compile(r"[^a-zA-Z0-9_:]")
 
 
+def prom_name(name: str, prefix: str = "tcr") -> str:
+    """A conformant Prometheus metric name: invalid characters collapse
+    to ``_``, and the result may not start with a digit
+    (``[a-zA-Z_:][a-zA-Z0-9_:]*`` per the exposition format spec)."""
+    s = _PROM_SANITIZE.sub("_", name)
+    full = f"{prefix}_{s}" if prefix else s
+    if not full or full[0].isdigit():
+        full = "_" + full
+    return full
+
+
+def prom_escape_label(value) -> str:
+    """Label-VALUE escaping per the text exposition format: backslash,
+    double-quote and newline must be escaped inside the quotes."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _prom_escape_help(text: str) -> str:
+    """# HELP text escaping: backslash and newline only."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 class Histogram:
     """Bounded histogram with deterministic decimation.
 
@@ -176,33 +199,57 @@ class MetricsRegistry(Counters):
     def prometheus_text(self, prefix: str = "tcr") -> str:
         """Prometheus text exposition: counters as ``counter``, hiwater
         and gauges as ``gauge``, samples and histograms as ``summary``
-        (quantiles + _sum + _count)."""
+        (quantiles + _sum + _count).  Conformance (ISSUE 10 satellite):
+        sanitized names with the leading-digit rule, escaped label
+        values, one ``# HELP``/``# TYPE`` pair per metric, and sanitize
+        collisions disambiguated (two raw names collapsing to one
+        exposition name — or one raw name reused across metric kinds —
+        would otherwise emit a duplicate ``# TYPE``, invalid per the
+        format spec).  The suffix is a per-base ordinal, so a colliding
+        metric's exposed name stays stable as unrelated metrics appear
+        between scrapes."""
+        seen: dict = {}  # exposition base -> times already emitted
+
         def _n(name: str) -> str:
-            return f"{prefix}_{_PROM_SANITIZE.sub('_', name)}"
+            full = prom_name(name, prefix)
+            k = seen.get(full, 0)
+            seen[full] = k + 1
+            return full if k == 0 else f"{full}_{k}"
 
         out: List[str] = []
+
+        def _head(n: str, raw: str, mtype: str, what: str) -> None:
+            out.append(f"# HELP {n} "
+                       f"{_prom_escape_help(f'{what} {raw!r}')}")
+            out.append(f"# TYPE {n} {mtype}")
+
         for name in sorted(self._counts):
-            out.append(f"# TYPE {_n(name)} counter")
-            out.append(f"{_n(name)} {self._counts[name]}")
+            n = _n(name)
+            _head(n, name, "counter", "monotonic counter")
+            out.append(f"{n} {self._counts[name]}")
         for name in sorted(self._hiwater):
-            out.append(f"# TYPE {_n(name)} gauge")
-            out.append(f"{_n(name)} {self._hiwater[name]}")
+            n = _n(name)
+            _head(n, name, "gauge", "high-water gauge")
+            out.append(f"{n} {self._hiwater[name]}")
         for name in sorted(self._gauges):
-            out.append(f"# TYPE {_n(name)} gauge")
-            out.append(f"{_n(name)} {self._gauges[name]}")
+            n = _n(name)
+            _head(n, name, "gauge", "last-value gauge")
+            out.append(f"{n} {self._gauges[name]}")
         for name in sorted(self._samples):
             total, count, _vmin, _vmax = self._sample_stats(name)
-            out.append(f"# TYPE {_n(name)} summary")
-            out.append(f"{_n(name)}_sum {total}")
-            out.append(f"{_n(name)}_count {count}")
+            n = _n(name)
+            _head(n, name, "summary", "mean-gauge sample")
+            out.append(f"{n}_sum {total}")
+            out.append(f"{n}_count {count}")
         for name in sorted(self._histos):
             h = self._histos[name]
-            out.append(f"# TYPE {_n(name)} summary")
+            n = _n(name)
+            _head(n, name, "summary", "bounded histogram")
             for p, v in h.quantiles().items():
                 q = float(p[1:]) / 100.0
-                out.append(f'{_n(name)}{{quantile="{q}"}} {v}')
-            out.append(f"{_n(name)}_sum {h.total}")
-            out.append(f"{_n(name)}_count {h.count}")
+                out.append(f'{n}{{quantile="{prom_escape_label(q)}"}} {v}')
+            out.append(f"{n}_sum {h.total}")
+            out.append(f"{n}_count {h.count}")
         return "\n".join(out) + "\n"
 
 

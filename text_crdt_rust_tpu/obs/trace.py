@@ -137,6 +137,12 @@ class Tracer:
     - ``keep_all=True`` additionally retains the full stream in memory
       (the determinism tests read it back via ``logical_bytes``);
     - ``path`` streams every event to a JSONL file as it happens;
+    - ``rotate_bytes`` caps each stream segment: when the active file
+      passes the cap it is closed and the stream continues in
+      ``<path>.1``, ``<path>.2``, ... (``segment_paths`` lists them in
+      order) — a long loadgen/cluster run no longer grows ONE unbounded
+      JSONL, and segments concatenate back to the identical stream
+      (``obs.analyze.load_events`` accepts the segment list);
     - ``enabled=False`` turns every entry point into a cheap no-op
       (the overhead-probe baseline arm).
 
@@ -147,6 +153,7 @@ class Tracer:
 
     def __init__(self, *, enabled: bool = True, ring: int = 512,
                  keep_all: bool = False, path: Optional[str] = None,
+                 rotate_bytes: Optional[int] = None,
                  validate: bool = True):
         from collections import deque
 
@@ -160,8 +167,14 @@ class Tracer:
         # Line-buffered: the events adjacent to a crash are exactly the
         # ones a flight recorder exists to preserve — they must be on
         # disk, not in a stdio buffer, when the process dies.
-        self._file = (open(path, "w", buffering=1)
-                      if (enabled and path) else None)
+        self._path = path
+        self.rotate_bytes = rotate_bytes
+        self.segment_paths: List[str] = []
+        self._segment_bytes = 0
+        self._file = None
+        if enabled and path:
+            self._file = open(path, "w", buffering=1)
+            self.segment_paths.append(path)
         self._subscribers: List[Callable[[dict], None]] = []
         if enabled:
             self.event("trace.header", schema=TRACE_SCHEMA_VERSION)
@@ -191,7 +204,19 @@ class Tracer:
         if self.keep_all:
             self.events.append(ev)
         if self._file is not None:
-            self._file.write(event_line(ev) + "\n")
+            line = event_line(ev) + "\n"
+            self._file.write(line)
+            self._segment_bytes += len(line)
+            # Size-capped segment rollover: rotation happens BETWEEN
+            # events (a line is never split), so the concatenated
+            # segments are byte-identical to an unrotated stream.
+            if (self.rotate_bytes
+                    and self._segment_bytes >= self.rotate_bytes):
+                self._file.close()
+                seg = f"{self._path}.{len(self.segment_paths)}"
+                self._file = open(seg, "w", buffering=1)
+                self.segment_paths.append(seg)
+                self._segment_bytes = 0
         for fn in self._subscribers:
             fn(ev)
         return ev

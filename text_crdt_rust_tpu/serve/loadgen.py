@@ -515,6 +515,9 @@ class ServeLoadGen:
                 "device_compiles": stats.get("device_compiles", 0),
                 "bundles_written": stats.get("bundles_written", 0),
                 "bundles_suppressed": stats.get("bundles_suppressed", 0),
+                # The recorder's own written-file count (must agree
+                # with the counter — asserted in test_obs_recorder).
+                "bundle_count": len(self.server.recorder.bundle_paths),
                 "bundles": list(self.server.recorder.bundle_paths),
             },
             "server": stats,
@@ -620,6 +623,9 @@ def main(argv=None) -> None:
                          "probe's baseline arm)")
     ap.add_argument("--trace-path", default=None,
                     help="stream trace events to this JSONL file")
+    ap.add_argument("--trace-rotate-bytes", type=int, default=None,
+                    help="size-cap per trace segment; the stream rolls "
+                         "to <path>.1, <path>.2, ... at the cap")
     ap.add_argument("--profile-dir", default=None,
                     help="opt-in jax.profiler capture directory "
                          "(ticks 1..profile_ticks)")
@@ -634,6 +640,7 @@ def main(argv=None) -> None:
                       lanes_per_shard=a.lanes,
                       wire_format=a.wire, ckpt_format=a.ckpt,
                       trace=not a.no_trace, trace_path=a.trace_path,
+                      trace_rotate_bytes=a.trace_rotate_bytes,
                       profile_dir=a.profile_dir)
     gen = ServeLoadGen(docs=a.docs, agents_per_doc=a.agents, ticks=a.ticks,
                        events_per_tick=a.events_per_tick, zipf_alpha=a.zipf,

@@ -51,7 +51,8 @@ class DocServer:
         self.counters = (counters if counters is not None
                          else MetricsRegistry())
         self.tracer = Tracer(enabled=cfg.trace, ring=cfg.trace_ring,
-                             keep_all=cfg.trace_keep, path=cfg.trace_path)
+                             keep_all=cfg.trace_keep, path=cfg.trace_path,
+                             rotate_bytes=cfg.trace_rotate_bytes)
         self.admission = AdmissionControl(
             max_queue_per_doc=cfg.max_queue_per_doc,
             max_queue_global=cfg.max_queue_global,
@@ -265,6 +266,13 @@ class DocServer:
                     ("min", "max", "p50", "p99", "count")):
                 out[key] = c[key]
         out["device_compiles"] = c.get("device_compiles", 0)
+        # Flight-recorder visibility (ISSUE 10 satellite): how many
+        # post-mortem bundles this run wrote and how many same-reason
+        # repeats were suppressed — a nonzero suppressed count in a
+        # summary is the "this run failed the same way many times"
+        # signal without grepping the obs dir.
+        out["bundles_written"] = c.get("bundles_written", 0)
+        out["bundles_suppressed"] = c.get("bundles_suppressed", 0)
         return out
 
     def stats(self) -> Dict[str, float]:
