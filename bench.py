@@ -1338,6 +1338,17 @@ def cfg_serve(args):
         device_compiles=report["obs"]["device_compiles"],
         trace_events=report["obs"]["trace_events"],
         obs_bundles=report["obs"]["bundles_written"],
+        # ISSUE 11: per-op provenance ride-along (additive fields — the
+        # row schema pins the floor, not the ceiling): spans tracked at
+        # the shipped sampling default, the conservation-audit verdict,
+        # and op-age-at-apply percentiles in logical ticks.
+        flow_spans=(report.get("flow") or {}).get(
+            "spans", {}).get("emitted", 0),
+        flow_audit_ok=(report.get("flow") or {}).get("audit_ok"),
+        flow_age_p50_ticks=(report.get("flow") or {}).get(
+            "ages_ticks", {}).get("p50", 0),
+        flow_age_p99_ticks=(report.get("flow") or {}).get(
+            "ages_ticks", {}).get("p99", 0),
         wire_format=col_wire["format"],
         ckpt_format=report["ckpt"]["format"],
         wire_bytes_total=col_wire["txn_bytes"],
@@ -1410,6 +1421,13 @@ def cfg_serve_lanes(args):
         ops_per_step_max=rep["tick_ms"].get("ops_per_step_max", 0.0),
         device_compiles=(rep.get("obs") or {}).get("device_compiles", 0),
         trace_events=(rep.get("obs") or {}).get("trace_events", 0),
+        flow_spans=(rep.get("flow") or {}).get(
+            "spans", {}).get("emitted", 0),
+        flow_audit_ok=(rep.get("flow") or {}).get("audit_ok"),
+        flow_age_p50_ticks=(rep.get("flow") or {}).get(
+            "ages_ticks", {}).get("p50", 0),
+        flow_age_p99_ticks=(rep.get("flow") or {}).get(
+            "ages_ticks", {}).get("p99", 0),
         p50_admission_to_applied_us=rep["latency_us"]["p50"],
         p99_admission_to_applied_us=rep["latency_us"]["p99"],
         evictions=rep["evictions"], restores=rep["restores"],
@@ -1581,7 +1599,7 @@ def run_ledger_check(args) -> int:
     if not_cpu:
         log(f"--check-ledger refused: cells {not_cpu} are not cpu "
             f"cells of {args.ledger} (device cells need silicon — "
-            f"perf/when_up_r10.sh re-records them)")
+            f"perf/when_up_r11.sh re-records them)")
         return 2
     # A committed cpu cell the probe no longer knows IS drift (a cell
     # rename/removal without a re-record) — report it as a named

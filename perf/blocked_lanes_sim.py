@@ -687,6 +687,10 @@ def serve_workload(smoke: bool = False, block_k: int = 0,
                 # the serve-lanes bench row records the same
                 # observability fields as the serve row.
                 "obs": r.get("obs"),
+                # ISSUE 11: per-op provenance census (spans, audit
+                # verdict, op-age percentiles) for the flow_* row
+                # fields.
+                "flow": r.get("flow"),
             }
             for eng, r in reports.items()
         },

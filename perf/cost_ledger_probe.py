@@ -32,11 +32,18 @@ Cells (kind ``cpu`` — the tier-1 gate re-derives all of them):
 - ``sp``           — the sequence-parallel engine's static ICI cost
   model at a tiny pinned shape: collectives/step by kind off the
   compiled HLO (the 124 = 94 all-reduce + 30 all-gather invariant),
-  flops/bytes banded.
+  flops/bytes banded;
+- ``flow``         — per-op provenance (ISSUE 11): the same small
+  loadgen at FULL flow sampling — span terminal-state census
+  (conservation audit asserted green before pinning) and
+  op-age-at-apply percentiles in exact logical ticks, the ROADMAP-7
+  pipelined-tick before/after latency contract.
 
-``--device`` (perf/when_up_r10.sh) appends the silicon cells — wall
-histograms + real-HLO costs on the default backend — without touching
-the cpu cells; the gate skips ``kind: device`` cells on CPU.
+``--device`` (perf/when_up_r11.sh) appends the silicon cells — wall
+histograms + real-HLO costs on the default backend, plus the flow
+cell's device variant (logical ages must reproduce EXACTLY on chip) —
+without touching the cpu cells; the gate skips ``kind: device`` cells
+on CPU.
 
 Run:  python perf/cost_ledger_probe.py [--out perf/COST_LEDGER.json]
                                        [--cells a,b] [--device]
@@ -89,7 +96,7 @@ _COLLECTIVE_RE = re.compile(
     r"all-gather|all_gather|all-reduce|all_reduce|collective-permute|"
     r"collective_permute|all-to-all|all_to_all", re.IGNORECASE)
 
-CPU_CELLS = ("serve", "serve-lanes", "fused-trace", "sp")
+CPU_CELLS = ("serve", "serve-lanes", "fused-trace", "sp", "flow")
 
 
 def _force_cpu():
@@ -297,6 +304,64 @@ def cell_serve_pair():
     return serve_cell, lanes_cell
 
 
+def _flow_metrics(rep: dict) -> dict:
+    """The ``flow`` family metrics off a loadgen report's flow block:
+    span terminal-state census + op-age-at-apply percentiles, ALL exact
+    (ages are logical-tick integers — the same-seed determinism that
+    pins every other cpu metric pins these).  The audit must be green
+    before anything is pinned: a ledger cell recording a leaky run
+    would gate the wrong contract."""
+    f = rep["flow"]
+    assert f["audit_ok"], f["findings"][:4]
+    assert f["spans"]["in_flight"] == 0, f
+    m = {
+        "flow_events": metric(f["flow_events"], "flow"),
+        "spans_emitted": metric(f["spans"]["emitted"], "flow"),
+        "spans_applied": metric(f["spans"]["applied"], "flow"),
+        "spans_rejected": metric(f["spans"]["rejected"], "flow"),
+        "spans_in_flight": metric(f["spans"]["in_flight"], "flow"),
+        "dup_applies": metric(f["duplicates"], "flow"),
+        "applies_device": metric(f["applies"]["device"], "flow"),
+        "applies_host": metric(f["applies"]["host"], "flow"),
+        "age_p50_ticks": metric(f["ages_ticks"]["p50"], "flow"),
+        "age_p99_ticks": metric(f["ages_ticks"]["p99"], "flow"),
+        "age_max_ticks": metric(f["ages_ticks"]["max"], "flow"),
+    }
+    for band, st in f["by_band"].items():
+        if st["count"]:
+            m[f"age_{band}_p50_ticks"] = metric(st["p50"], "flow")
+            m[f"age_{band}_p99_ticks"] = metric(st["p99"], "flow")
+    for cls, st in f["by_class"].items():
+        if st["count"]:
+            key = cls.replace("-", "_")
+            m[f"age_{key}_count"] = metric(st["count"], "flow")
+            m[f"age_{key}_p50_ticks"] = metric(st["p50"], "flow")
+    return m
+
+
+def cell_flow():
+    """The per-op provenance cell (ISSUE 11): the small seeded loadgen
+    with FULL flow sampling (``flow_sample_mod=1``) — every emitted
+    span tracked end to end, the conservation audit asserted green,
+    and the op-age-at-apply distribution pinned in exact logical
+    ticks.  This is the before/after latency contract the ROADMAP-7
+    pipelined-tick refactor runs against: logical ages must stay
+    byte-identical while only wall time moves."""
+    from text_crdt_rust_tpu.config import ServeConfig
+    from text_crdt_rust_tpu.serve.loadgen import ServeLoadGen
+
+    cfg = ServeConfig(engine="flat", flow_sample_mod=1, **SERVE_SHAPE)
+    gen = ServeLoadGen(cfg=cfg, **SMALL_LOADGEN)
+    rep = gen.run()
+    assert rep["converged"], rep["mismatches"][:4]
+    return {
+        "kind": "cpu",
+        "workload": {**SMALL_LOADGEN, **SERVE_SHAPE, "engine": "flat",
+                     "flow_sample_mod": 1},
+        "metrics": _flow_metrics(rep),
+    }
+
+
 def cell_fused_trace():
     """Generalized step fusion over a pinned real-trace prefix compiled
     at the serve lmax — the ISSUE-6 step economy as exact counters."""
@@ -377,7 +442,7 @@ def cell_sp():
 
 
 def cell_serve_device():
-    """Silicon cell (perf/when_up_r10.sh): the same small loadgen on
+    """Silicon cell (perf/when_up_r11.sh): the same small loadgen on
     the DEFAULT jax backend — per-bucket device-step wall histograms
     plus the real-HLO flat-kernel costs.  Wall metrics carry wide bands
     (they gate nothing on CPU; the cell is the committed record of what
@@ -412,6 +477,37 @@ def cell_serve_device():
     }
 
 
+def cell_flow_device():
+    """Silicon variant of the ``flow`` cell (perf/when_up_r11.sh): the
+    SAME full-sampling loadgen on the default jax backend.  Because op
+    ages are logical-tick integers, the chip must reproduce the cpu
+    cell's numbers EXACTLY — this cell is the cross-backend proof that
+    per-op latency accounting is device-independent — plus the run's
+    wall clock as a banded informational metric."""
+    import time
+
+    import jax
+
+    from text_crdt_rust_tpu.config import ServeConfig
+    from text_crdt_rust_tpu.serve.loadgen import ServeLoadGen
+
+    platform = jax.devices()[0].platform
+    cfg = ServeConfig(engine="flat", flow_sample_mod=1, **SERVE_SHAPE)
+    gen = ServeLoadGen(cfg=cfg, **SMALL_LOADGEN)
+    t0 = time.perf_counter()
+    rep = gen.run()
+    wall = time.perf_counter() - t0
+    assert rep["converged"], rep["mismatches"][:4]
+    m = _flow_metrics(rep)
+    m["run_wall_s"] = metric(round(wall, 3), "wall", tol=WALL_TOL)
+    return {
+        "kind": "device",
+        "workload": {**SMALL_LOADGEN, **SERVE_SHAPE, "engine": "flat",
+                     "flow_sample_mod": 1, "platform": platform},
+        "metrics": m,
+    }
+
+
 def derive_cells(names=None) -> dict:
     """Derive the named cpu cells (all of them by default).  ``serve``
     and ``serve-lanes`` share one loadgen run, so requesting either
@@ -432,6 +528,8 @@ def derive_cells(names=None) -> dict:
         out["fused-trace"] = cell_fused_trace()
     if "sp" in names:
         out["sp"] = cell_sp()
+    if "flow" in names:
+        out["flow"] = cell_flow()
     return out
 
 
@@ -450,7 +548,8 @@ def main():
     import jax
 
     if a.device:
-        cells = {"serve-device": cell_serve_device()}
+        cells = {"serve-device": cell_serve_device(),
+                 "flow-device": cell_flow_device()}
         with open(a.out) as f:
             ledger = json.load(f)
         ledger["cells"].update(cells)

@@ -1,0 +1,169 @@
+"""Per-op provenance probe (ISSUE 11 acceptance): the conservation
+audit, flow byte-determinism, and the flow tracer's overhead matrix at
+the 200-doc faulted acceptance shape.
+
+Three arms of the SAME seeded loadgen (the §14 probe pattern —
+``perf/obs_overhead_probe.py`` — with flow-specific arms):
+
+- ``off``     — ``flow_sample_mod=0``: tracing on (the shipped PR-8
+  default), zero flow events — the overhead baseline;
+- ``default`` — ``flow_sample_mod=16`` (the shipped default): ~1/16 of
+  agents span-tracked end to end;
+- ``full``    — ``flow_sample_mod=1``: EVERY emitted op tracked.  This
+  arm is the acceptance run: drops/dups/reorders at 10% per fault
+  class make leaks likely, and the conservation audit must still
+  terminally account every span (zero leaked, zero double-applied)
+  after the anti-entropy drain.
+
+Timing arms take the min of ``reps`` runs (default 3 — the committed
+artifact's protocol; min-of-N against shared-box noise; loop wall
+``device_ticks_wall_s`` is the basis).  Two untimed
+``full`` runs additionally pin same-seed byte-identity of the logical
+stream INCLUDING flow events, at the full 200-doc shape.
+
+Acceptance: default-sampling overhead < 5% (the PERF.md §14 bar),
+audit green at full sampling, streams byte-identical.  Writes
+``perf/flow_r13.json``.
+
+Run: python perf/flow_probe.py [--smoke] [--reps N] [--out PATH]
+"""
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except RuntimeError:
+    pass  # in-process import after backend init (the tier-1 smoke)
+
+from text_crdt_rust_tpu.config import ServeConfig  # noqa: E402
+from text_crdt_rust_tpu.serve.loadgen import ServeLoadGen  # noqa: E402
+
+FLOOR_PCT = 5.0
+ARMS = {"off": 0, "default": 16, "full": 1}
+
+
+def run_one(sample_mod: int, smoke: bool, seed: int = 7,
+            keep_trace: bool = False):
+    """One seeded loadgen run at the given flow sampling; returns
+    (report, logical_trace_bytes)."""
+    docs, ticks, events = (24, 12, 16) if smoke else (200, 60, 48)
+    cfg = ServeConfig(engine="flat", num_shards=2, lanes_per_shard=16,
+                      flow_sample_mod=sample_mod, trace_keep=keep_trace)
+    gen = ServeLoadGen(docs=docs, agents_per_doc=3, ticks=ticks,
+                      events_per_tick=events, zipf_alpha=1.1,
+                      fault_rate=0.10, local_prob=0.25, seed=seed,
+                      cfg=cfg)
+    rep = gen.run()
+    assert rep["converged"], rep["mismatches"][:4]
+    trace_bytes = (gen.server.tracer.logical_bytes()
+                   if keep_trace else None)
+    return rep, trace_bytes
+
+
+def run_matrix(smoke: bool = False, reps: int = 3) -> dict:
+    arms = {}
+    timings = {a: [] for a in ARMS}
+    for arm, mod in ARMS.items():
+        for _r in range(reps):
+            # Timed arms never set trace_keep (the §14 discipline: the
+            # shipped default pays ring-only retention).
+            t0 = time.perf_counter()
+            rep, _ = run_one(mod, smoke)
+            wall = time.perf_counter() - t0
+            timings[arm].append({
+                "total_wall_s": round(wall, 3),
+                "loop_wall_s": rep["device_ticks_wall_s"],
+            })
+            arms[arm] = rep
+
+    # Byte-determinism of the FULL flow stream at this shape, on two
+    # untimed runs (flow events are logical-only, so the whole stream
+    # must stay byte-identical).
+    _repa, trace_a = run_one(1, smoke, keep_trace=True)
+    _repb, trace_b = run_one(1, smoke, keep_trace=True)
+    trace_identical = trace_a == trace_b
+
+    flow_full = arms["full"]["flow"]
+    flow_default = arms["default"]["flow"]
+    loops = {a: min(t["loop_wall_s"] for t in timings[a]) for a in ARMS}
+    overhead = {
+        a: round((loops[a] - loops["off"]) / loops["off"] * 100.0, 2)
+        for a in ("default", "full")
+    }
+    out = {
+        "probe": "flow_provenance",
+        "smoke": smoke,
+        "workload": {
+            "docs": arms["full"]["docs"], "seed": 7, "engine": "flat",
+            "fault_rate": 0.10, "reps_per_arm": reps,
+            "basis": "min loop wall (device_ticks_wall_s) per arm",
+            "arms": dict(ARMS),
+        },
+        "loop_wall_s": {a: round(loops[a], 3) for a in ARMS},
+        "overhead_pct": overhead,
+        "audit": {
+            "full": {
+                "ok": flow_full["audit_ok"],
+                "spans": flow_full["spans"],
+                "duplicates": flow_full["duplicates"],
+                "leaks": flow_full["leaks"],
+                "findings": flow_full["findings"][:4],
+            },
+            "default": {
+                "ok": flow_default["audit_ok"],
+                "spans": flow_default["spans"],
+            },
+        },
+        "ages_ticks": flow_full["ages_ticks"],
+        "age_by_band": flow_full["by_band"],
+        "age_by_class": flow_full["by_class"],
+        "flow_events_full": flow_full["flow_events"],
+        "flow_events_default": flow_default["flow_events"],
+        "trace_bytes_logical_full": len(trace_a) if trace_a else 0,
+        "trace_byte_identical_across_runs": trace_identical,
+        "converged": {a: arms[a]["converged"] for a in arms},
+        "acceptance": {
+            "floor_pct": FLOOR_PCT,
+            # The shipped default must stay under the §14 bar; the
+            # full-sampling arm is the audit vehicle, not a shipping
+            # config, so its overhead is recorded but not gated.
+            "pass": bool(overhead["default"] < FLOOR_PCT
+                         and flow_full["audit_ok"]
+                         and flow_full["spans"]["in_flight"] == 0
+                         and trace_identical
+                         and all(a["converged"]
+                                 for a in arms.values())),
+        },
+        "note": "CPU run (tier-1 harness); flow events are host-side "
+                "python dicts, so the CPU bound transfers to device "
+                "backends.  Negative overhead = the run-to-run noise "
+                "floor exceeds the tracker cost.  The audit covers "
+                "EVERY emitted span at mod=1: zero leaked / "
+                "double-applied after the anti-entropy drain is the "
+                "ISSUE-11 conservation acceptance.",
+    }
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--out", default="perf/flow_r13.json")
+    a = ap.parse_args()
+    out = run_matrix(smoke=a.smoke, reps=a.reps)
+    with open(a.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out, indent=1))
+    if not out["acceptance"]["pass"]:
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

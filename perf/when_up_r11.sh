@@ -1,0 +1,69 @@
+#!/bin/bash
+# Round-11 recovery watcher (ISSUE 11 / ROADMAP #1): supersedes
+# when_up_r10.sh and keeps its gate chain — matmul tunnel probe ->
+# compile pin -> fused kevin device smoke -> fused serve-lanes loadgen
+# smoke -> kevin full 5M -> the remaining rows via --merge-rows — then
+# the COST LEDGER device re-record.  New in r11: the ledger --device
+# pass now ALSO appends the `flow-device` cell (per-op provenance on
+# the chip: op-age-at-apply is logical-tick exact, so silicon must
+# reproduce the committed cpu `flow` cell's ages bit for bit — the
+# cross-backend proof that per-op latency accounting is device-
+# independent — plus the run's wall as an informational band).
+# bench.py --check-ledger re-runs once at the end so a drifted cpu
+# cell is caught in the same session that recorded silicon.  Safe to
+# re-run; appends to perf/when_up_r11.log.
+set -u
+cd /root/repo
+while true; do
+  if timeout 240 python -c "
+import jax, numpy as np, jax.numpy as jnp
+x = jnp.ones((128,128), jnp.bfloat16)
+assert float(np.asarray(x @ x)[0,0]) == 128.0
+" >/dev/null 2>&1; then
+    echo "$(date -u +%H:%M:%S) tunnel is back (r11 watcher)" >> perf/when_up_r11.log
+    break
+  fi
+  echo "$(date -u +%H:%M:%S) still down (r11)" >> perf/when_up_r11.log
+  sleep 120
+done
+timeout 2400 python perf/compile_pin.py >> perf/compile_pin_r11.log 2>&1 \
+  || echo "PIN FAILED/TIMED OUT rc=$? - investigate before trusting bench" \
+       >> perf/compile_pin_r11.log
+# Fused-kernel device smoke first: a tiny fused kevin (2048 prepends,
+# W=8) proves the W-row splice compiles on real Mosaic before
+# committing to the 40-min full run.
+timeout 1800 python bench.py --config kevin --smoke --no-probe \
+  >> perf/when_up_r11.log 2>&1 \
+  || { echo "fused kevin device smoke FAILED rc=$? - NOT re-recording" \
+         >> perf/when_up_r11.log; exit 1; }
+# Second gate: a fused serve-lanes loadgen smoke — the blocked mixed
+# kernel's fused splice + the serve stack's fused ticks on device.
+timeout 1800 python -m text_crdt_rust_tpu.serve.loadgen --device \
+  --docs 24 --ticks 10 --engine rle-lanes-mixed \
+  >> perf/when_up_r11.log 2>&1 \
+  || { echo "fused serve-lanes device smoke FAILED rc=$? - NOT re-recording" \
+         >> perf/when_up_r11.log; exit 1; }
+# Headline: kevin at full 5M, fused W=64 (rle-hbm-fused row).
+timeout 7200 python bench.py --config kevin --merge-rows --no-probe \
+  >> perf/bench_kevin_r11.log 2>&1 \
+  || echo "kevin re-record FAILED rc=$?" >> perf/when_up_r11.log
+# Remaining rows, most verdict-critical first; every merged row is
+# ledger_version-stamped by the exporter (serve/serve-lanes rows now
+# carry the additive flow_* provenance fields).
+for cfg in northstar 4 5r 5 serve serve-lanes sp; do
+  timeout 7200 python bench.py --config "$cfg" --merge-rows --no-probe \
+    >> "perf/bench_cfg${cfg}_r11.log" 2>&1 \
+    || echo "config $cfg re-record FAILED rc=$?" >> perf/when_up_r11.log
+done
+# The cost-ledger silicon cells: device-step wall histograms +
+# real-HLO costs + the flow-device per-op provenance cell, appended to
+# the committed ledger (cpu cells untouched).
+timeout 3600 python perf/cost_ledger_probe.py --device \
+  >> perf/when_up_r11.log 2>&1 \
+  || echo "ledger device re-record FAILED rc=$?" >> perf/when_up_r11.log
+# And prove the cpu contract still holds from this very checkout.
+timeout 1800 env JAX_PLATFORMS=cpu python bench.py --check-ledger \
+  >> perf/when_up_r11.log 2>&1 \
+  || echo "LEDGER CHECK FAILED rc=$? - cpu cost contract drifted" \
+       >> perf/when_up_r11.log
+echo "$(date -u +%H:%M:%S) r11 re-record done" >> perf/when_up_r11.log

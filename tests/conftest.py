@@ -46,6 +46,21 @@ def _is_tier1(config) -> bool:
     return "not slow" in (config.getoption("-m") or "")
 
 
+def _slowest_calls(terminalreporter, n: int = 15):
+    """The session's ``n`` slowest test call phases, from the reports
+    the terminal reporter already holds — so the budget warning can
+    NAME the tests to demote instead of sending someone off to re-run
+    with ``--durations``."""
+    calls = []
+    for reports in terminalreporter.stats.values():
+        for rep in reports:
+            if (getattr(rep, "when", None) == "call"
+                    and hasattr(rep, "duration")):
+                calls.append((rep.duration, rep.nodeid))
+    calls.sort(reverse=True)
+    return calls[:n]
+
+
 def pytest_terminal_summary(terminalreporter, exitstatus, config):
     wall = time.time() - _SESSION_T0
     if not _is_tier1(config):
@@ -63,6 +78,12 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
         f"before the tier-1 command starts flaking.  Set "
         f"TCR_TIER1_BUDGET_FAIL=1 to make this a hard failure, "
         f"TCR_TIER1_BUDGET_S to adjust the budget.", red=True, bold=True)
+    slowest = _slowest_calls(terminalreporter)
+    if slowest:
+        tr.write_line("slowest 15 call phases (demotion candidates):",
+                      bold=True)
+        for dur, nodeid in slowest:
+            tr.write_line(f"  {dur:7.2f}s  {nodeid}")
 
 
 def pytest_sessionfinish(session, exitstatus):

@@ -47,6 +47,17 @@ def test_committed_bench_all_rows_validate():
             assert r["schema_version"] == ROW_SCHEMA_VERSION
 
 
+def test_additive_flow_fields_validate_without_schema_bump():
+    """ISSUE 11 satellite: the serve/serve-lanes rows' flow_* fields
+    (spans tracked, audit verdict, age percentiles in ticks) are
+    ADDITIVE — the schema pins the floor, not the ceiling, so no
+    row-schema major bump and old rows stay comparable."""
+    extra = row(flow_spans=2880, flow_audit_ok=True,
+                flow_age_p50_ticks=8, flow_age_p99_ticks=25)
+    validate_row(extra)  # would raise on any floor violation
+    assert extra["schema_version"] == ROW_SCHEMA_VERSION
+
+
 def test_validate_rejects_missing_field():
     bad = row()
     del bad["metric"]

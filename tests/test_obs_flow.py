@@ -1,0 +1,493 @@
+"""obs/flow (ISSUE 11): per-op provenance spans, the conservation
+audit, op-age distributions, and the layer plumb-throughs (frame ids,
+span-carrying rejects, fused-super-step attribution, the divergence
+bundle's flow-path join).
+
+The load-bearing pair: (1) the faulted loadgen run terminally accounts
+EVERY emitted span (zero leaked / double-applied) — conservation as a
+gated invariant, not folklore; (2) the leak-injection harness proves
+the audit fails LOUD naming the span, so a green audit means
+something."""
+import json
+
+import pytest
+
+from text_crdt_rust_tpu.config import ServeConfig
+from text_crdt_rust_tpu.obs import analyze as A
+from text_crdt_rust_tpu.obs.flow import (
+    FlowTracker,
+    _merge,
+    _subtract,
+    agent_sampled,
+    audit_spans,
+    flow_report,
+    spans_from_events,
+)
+from text_crdt_rust_tpu.obs.trace import Tracer, validate_event
+
+
+def flow_run(seed=7, sample_mod=1, workload="scatter", **cfg_kw):
+    """The small faulted loadgen at full flow sampling (the
+    ``test_obs_trace.small_loadgen_run`` shape + flow)."""
+    from text_crdt_rust_tpu.serve.loadgen import ServeLoadGen
+
+    cfg = ServeConfig(num_shards=1, lanes_per_shard=4,
+                      flow_sample_mod=sample_mod, **cfg_kw)
+    gen = ServeLoadGen(docs=6, agents_per_doc=2, ticks=6,
+                       events_per_tick=12, fault_rate=0.10, seed=seed,
+                       cfg=cfg, workload=workload)
+    rep = gen.run()
+    assert rep["converged"], rep["mismatches"]
+    return gen, rep
+
+
+@pytest.fixture(scope="module")
+def run_pair():
+    return flow_run()
+
+
+# ------------------------------------------------- interval helpers -----
+
+
+def test_interval_merge_and_subtract():
+    assert _merge([(5, 8), (0, 3), (2, 6)]) == [(0, 8)]
+    assert _merge([(0, 2), (4, 6)]) == [(0, 2), (4, 6)]
+    assert _subtract([(0, 10)], [(2, 4), (6, 8)]) == [
+        (0, 2), (4, 6), (8, 10)]
+    assert _subtract([(0, 4)], [(0, 4)]) == []
+    assert _subtract([(0, 4)], []) == [(0, 4)]
+
+
+def test_agent_sampling_is_deterministic_and_total_at_mod_1():
+    assert agent_sampled("anyone", 1)
+    assert not agent_sampled("anyone", 0)
+    for mod in (2, 4, 16):
+        names = [f"d{i:04d}.a{j}" for i in range(40) for j in range(3)]
+        picks = [n for n in names if agent_sampled(n, mod)]
+        assert picks == [n for n in names if agent_sampled(n, mod)]
+        assert 0 < len(picks) < len(names)
+
+
+# ------------------------------------------- the conservation audit -----
+
+
+def test_faulted_loadgen_conserves_every_span(run_pair):
+    """The tentpole acceptance at small scale: 10% drops / dups /
+    reorders / truncations / bit-flips, and after the anti-entropy
+    drain every emitted op span is terminally accounted."""
+    gen, rep = run_pair
+    f = rep["flow"]
+    assert f["audit_ok"], f["findings"]
+    assert f["spans"]["in_flight"] == 0
+    assert f["duplicates"] == 0 and f["leaks"] == 0
+    assert f["spans"]["emitted"] > 50
+    assert (f["spans"]["applied"] + f["spans"]["rejected"]
+            == f["spans"]["emitted"])
+    # The flow ledger agrees with the server's own typed counters:
+    # every invalid-position local drop is a rejected span.
+    assert f["spans"]["rejected"] == rep["server"]["events_invalid"]
+    # Ages exist and are logical ticks.
+    assert f["ages_ticks"]["count"] == f["applies"]["device"] + \
+        f["applies"]["host"]
+    assert f["ages_ticks"]["p99"] >= f["ages_ticks"]["p50"] >= 0
+    # Every emitted flow event validates against the trace schema.
+    for ev in gen.server.flow.records:
+        validate_event(ev)
+
+
+def test_leak_injection_fails_loud_naming_the_span(run_pair):
+    """Remove one span's terminal apply -> the audit names exactly that
+    (doc, agent, seq) range with its last-known location."""
+    gen, _rep = run_pair
+    records = gen.server.flow.records
+    victim = next(r for r in records
+                  if r["k"] == "flow.apply" and "lk" not in r)
+    injected = [r for r in records if r is not victim]
+    rep = flow_report(injected, expect_terminal=True)
+    assert not rep["audit_ok"]
+    leak = next(f for f in rep["findings"] if f["kind"] == "leak")
+    assert leak["doc"] == victim["doc"]
+    assert leak["agent"] == victim["agent"]
+    assert leak["seq"] >= victim["seq"]
+    assert "last seen at" in leak["detail"]
+
+
+def test_duplicate_apply_fails_loud(run_pair):
+    gen, _rep = run_pair
+    records = gen.server.flow.records
+    victim = next(r for r in records
+                  if r["k"] == "flow.apply" and "lk" not in r)
+    rep = flow_report(records + [dict(victim)], expect_terminal=True)
+    assert not rep["audit_ok"]
+    dup = next(f for f in rep["findings"]
+               if f["kind"] == "duplicate-apply")
+    assert dup["doc"] == victim["doc"]
+    assert dup["agent"] == victim["agent"]
+    assert "applied twice" in dup["detail"]
+
+
+def test_phantom_apply_is_a_finding():
+    tr = Tracer(ring=8, keep_all=True)
+    flow = FlowTracker(tr, sample_mod=1)
+    flow.applied("d0", "ghost", 0, 4, "device")
+    findings = audit_spans(spans_from_events(flow.records))
+    assert findings and findings[0]["kind"] == "phantom-apply"
+    assert "never emitted" in findings[0]["detail"]
+
+
+def test_in_flight_spans_name_their_location():
+    """The third terminal state: in-flight-at-shutdown spans carry a
+    NAMED location derived from their last lifecycle stage."""
+    from text_crdt_rust_tpu.common import RemoteId, RemoteIns, RemoteTxn
+
+    tr = Tracer(ring=8, keep_all=True)
+    flow = FlowTracker(tr, sample_mod=1)
+    root = RemoteId("ROOT", 0)
+
+    def txn(agent, seq, n):
+        return RemoteTxn(RemoteId(agent, seq), [root],
+                         [RemoteIns(root, root, "x" * n)])
+
+    flow.emit_txns("d0", [txn("a", 0, 3)])              # emitted only
+    flow.emit_txns("d0", [txn("b", 0, 2)])
+    flow.framed("d0", [txn("b", 0, 2)], frame=7)        # framed
+    flow.emit_txns("d0", [txn("c", 4, 2)])
+    flow.framed("d0", [txn("c", 4, 2)], frame=8)
+    flow.buffered("d0", txn("c", 4, 2), "held")         # causal gap
+    # Non-strict mode: in-flight is a counted state, not a finding.
+    rep = flow_report(flow.records, expect_terminal=False)
+    assert rep["audit_ok"]
+    assert rep["spans"]["in_flight"] == 3
+    # Strict (end-of-run) mode: each leak names its location.
+    rep = flow_report(flow.records, expect_terminal=True)
+    assert not rep["audit_ok"]
+    locs = {f["agent"]: f["detail"] for f in rep["findings"]}
+    assert "network" in locs["a"]
+    assert "admission" in locs["b"]
+    assert "causal-buffer" in locs["c"]
+
+
+def test_local_apply_counts_once_in_flow_events():
+    """Review fix: an lk apply is indexed both by ordinal (to close
+    the emission) and by realized seq (for the interval audit) — the
+    census must count it ONCE."""
+    tr = Tracer(ring=8, keep_all=True)
+    flow = FlowTracker(tr, sample_mod=1)
+    lk = flow.emit_local("d0", "editor", 3)
+    flow.applied("d0", "editor", 0, 3, "host", lk=lk)
+    rep = flow_report(flow.records, expect_terminal=True)
+    assert rep["audit_ok"]
+    assert rep["flow_events"] == 2  # emit + apply, not 3
+
+
+def test_truncated_retention_refuses_to_certify(monkeypatch):
+    """Review fix: in-process retention is bounded (the PR-8 ring
+    discipline) — a tracker that hit its cap must refuse to claim a
+    clean audit and point at the offline trace path."""
+    tr = Tracer(ring=8, keep_all=True)
+    flow = FlowTracker(tr, sample_mod=1, max_records=2)
+    for seq in range(3):
+        flow.applied("d0", "a", seq, 1, "host")
+    assert flow.truncated and len(flow.records) == 2
+    rep = flow.report()
+    assert not rep["audit_ok"]
+    assert rep["findings"][0]["kind"] == "records-truncated"
+    assert "analyze.py flow --audit" in rep["findings"][0]["detail"]
+
+
+def test_local_spans_conserve_and_leak_loud():
+    tr = Tracer(ring=8, keep_all=True)
+    flow = FlowTracker(tr, sample_mod=1)
+    lk0 = flow.emit_local("d0", "editor", 3)
+    flow.applied("d0", "editor", 0, 3, "host", lk=lk0)
+    lk1 = flow.emit_local("d0", "editor", 2)
+    flow.rejected("d0", "editor", "invalid-position", lk=lk1)
+    assert flow_report(flow.records,
+                       expect_terminal=True)["audit_ok"]
+    lk2 = flow.emit_local("d0", "editor", 1)
+    assert lk2 == 2
+    rep = flow_report(flow.records, expect_terminal=True)
+    assert not rep["audit_ok"]
+    f = rep["findings"][0]
+    assert f["kind"] == "local-leak" and "lk=2" in f["detail"]
+
+
+# ------------------------------------- eviction / restore conservation --
+
+
+def test_evict_restore_replay_is_not_a_duplicate_apply(run_pair):
+    """The small shape evicts and restores (6 docs on 4 lanes); the
+    delta-chain restore REPLAYS checkpointed ops internally — which
+    must re-create state, never re-apply it into the flow ledger.  The
+    audit stays green across every evict->restore cycle AND the
+    residency conservation pairs match exactly."""
+    gen, rep = run_pair
+    assert rep["server"]["restores"] > 0, "shape stopped exercising restore"
+    assert rep["flow"]["audit_ok"]
+    events = gen.server.flow.records
+    evicts = [e for e in events if e["k"] == "residency.evict"]
+    restores = [e for e in events if e["k"] == "residency.restore"]
+    assert evicts and restores
+    assert all("n" in e and "orders" in e for e in evicts + restores)
+
+
+def test_tampered_restore_count_is_an_audit_finding(run_pair):
+    """A restore replay that re-applied history would inflate the
+    restored doc's item/order counts — inject exactly that and the
+    audit names the doc."""
+    gen, _rep = run_pair
+    events = [dict(e) for e in gen.server.flow.records]
+    victim = next(e for e in events
+                  if e["k"] == "residency.restore" and "n" in e)
+    victim["n"] += 5  # "the replay applied 5 items twice"
+    findings = audit_spans(spans_from_events(events))
+    bad = [f for f in findings if f["kind"] == "evict-restore-mismatch"]
+    assert bad and bad[0]["doc"] == victim["doc"]
+    assert "re-apply" in bad[0]["detail"]
+
+
+# ------------------------------------------------- rotated segments -----
+
+
+def test_audit_over_rotated_segments_with_mid_span_boundary(tmp_path):
+    """ISSUE 11 satellite: a span whose lifecycle straddles a segment
+    rollover reassembles through ``analyze.load_events`` — the offline
+    audit equals the in-process one, byte for byte."""
+    p = str(tmp_path / "flow.jsonl")
+    gen, rep = flow_run(trace_path=p, trace_rotate_bytes=4096)
+    segs = gen.server.tracer.segment_paths
+    assert len(segs) > 2, "rotation cap never hit — shrink rotate_bytes"
+    events = A.load_events(segs)
+    offline = flow_report(events, expect_terminal=True)
+    assert offline["audit_ok"], offline["findings"]
+    # The offline census equals the in-process flow block exactly.
+    inproc = dict(rep["flow"])
+    inproc.pop("sample_mod")
+    assert offline == inproc
+    # At least one span's lifecycle crosses a segment boundary (the
+    # boundary-mid-span case the satellite names).
+    import itertools
+
+    seg_of = {}
+    for si, seg in enumerate(segs):
+        for line in open(seg):
+            ev = json.loads(line)
+            if ev.get("k", "").startswith("flow.") and "seq" in ev:
+                key = (ev["doc"], ev["agent"], ev["seq"])
+                seg_of.setdefault(key, set()).add(si)
+    assert any(len(s) > 1 for s in seg_of.values()), \
+        "no span straddled a rotation boundary"
+    del itertools
+
+
+def test_analyze_flow_cli_audit_exit_codes(tmp_path, capsys):
+    p = str(tmp_path / "t.jsonl")
+    gen, _rep = flow_run(trace_path=p)
+    assert A.main(["flow", p, "--audit"]) == 0
+    out = capsys.readouterr()
+    assert "conservation audit OK" in out.err
+    # Tamper: drop the last flow.apply line -> exit 1 naming the span.
+    lines = open(p).read().splitlines()
+    drop = max(i for i, ln in enumerate(lines)
+               if '"k":"flow.apply"' in ln)
+    bad = str(tmp_path / "bad.jsonl")
+    with open(bad, "w") as f:
+        f.write("\n".join(ln for i, ln in enumerate(lines)
+                          if i != drop) + "\n")
+    assert A.main(["flow", bad, "--audit"]) == 1
+    out = capsys.readouterr()
+    assert "CONSERVATION AUDIT FAILED" in out.err
+    victim = json.loads(lines[drop])
+    assert victim["agent"] in out.err
+
+
+# ------------------------------------------------- layer plumb-throughs --
+
+
+def test_sampled_subset_is_end_to_end_complete():
+    """Per-AGENT sampling keeps every tracked span complete, so the
+    audit holds at any mod — the property that lets the shipped
+    default sample and still mean something."""
+    gen, rep = flow_run(sample_mod=4)
+    f = rep["flow"]
+    assert 0 < f["spans"]["emitted"]
+    assert f["audit_ok"], f["findings"]
+    assert f["spans"]["in_flight"] == 0
+    agents = {r["agent"] for r in gen.server.flow.records
+              if r["k"].startswith("flow.")}
+    assert all(agent_sampled(a, 4) for a in agents)
+
+
+def test_admission_reject_event_carries_offending_span(run_pair):
+    """ISSUE 11 satellite: admission rejects name the (agent, seq)
+    range, not just the reason class."""
+    from text_crdt_rust_tpu.common import RemoteId, RemoteIns, RemoteTxn
+    from text_crdt_rust_tpu.serve.admission import AdmissionError
+    from text_crdt_rust_tpu.serve.server import DocServer
+
+    srv = DocServer(ServeConfig(num_shards=1, lanes_per_shard=2,
+                                trace_keep=True, max_txn_len=4,
+                                flow_sample_mod=1))
+    srv.admit_doc("d0")
+    root = RemoteId("ROOT", 0)
+    big = RemoteTxn(RemoteId("spammer", 7), [root],
+                    [RemoteIns(root, root, "x" * 64)])
+    with pytest.raises(AdmissionError):
+        srv.submit_txn("d0", big)
+    ev = next(e for e in srv.tracer.events
+              if e["k"] == "admission.reject")
+    assert ev["agent"] == "spammer" and ev["seq"] == 7
+    assert ev["n"] == 64 and ev["doc"] == "d0"
+    # And the span's flow ledger shows the typed terminal rejection.
+    fr = next(e for e in srv.flow.records if e["k"] == "flow.reject")
+    assert fr["agent"] == "spammer" and fr["seq"] == 7
+    srv.close_obs()
+
+
+def test_codec_reject_carries_span_for_invalid_txn(monkeypatch):
+    """A CRC-valid frame whose txn fails structural validation: the
+    codec.reject event names the offending (agent, seq) range."""
+    from text_crdt_rust_tpu.common import RemoteId, RemoteIns, RemoteTxn
+    from text_crdt_rust_tpu.net import codec
+    from text_crdt_rust_tpu.serve.admission import AdmissionError
+    from text_crdt_rust_tpu.serve.server import DocServer
+
+    root = RemoteId("ROOT", 0)
+    bad = RemoteTxn(RemoteId("evil", 3), [],  # no parents: invalid
+                    [RemoteIns(root, root, "hi")])
+    monkeypatch.setattr(codec, "validate_remote_txn", lambda t: None)
+    frame = codec.encode_txns([bad])
+    monkeypatch.undo()
+    with pytest.raises(codec.CodecError) as ei:
+        codec.decode_frame(frame)
+    assert ei.value.agent == "evil" and ei.value.seq == 3
+    assert ei.value.n == 2
+
+    srv = DocServer(ServeConfig(num_shards=1, lanes_per_shard=2,
+                                trace_keep=True))
+    srv.admit_doc("d0")
+    with pytest.raises(AdmissionError):
+        srv.submit_frame("d0", frame)
+    ev = next(e for e in srv.tracer.events if e["k"] == "codec.reject")
+    assert ev["agent"] == "evil" and ev["seq"] == 3 and ev["n"] == 2
+    srv.close_obs()
+
+
+def test_frame_id_is_stored_crc_and_deterministic():
+    from text_crdt_rust_tpu.common import RemoteId, RemoteIns, RemoteTxn
+    from text_crdt_rust_tpu.net import codec
+
+    root = RemoteId("ROOT", 0)
+    txn = RemoteTxn(RemoteId("a", 0), [root],
+                    [RemoteIns(root, root, "hello")])
+    frame = codec.encode_txns([txn])
+    kind, value, off, info = codec.decode_frame_ex(frame)
+    assert kind == codec.KIND_TXNS and off == len(frame)
+    assert info.length == len(frame)
+    import struct
+
+    assert info.crc == struct.unpack("<I", frame[-4:])[0]
+    # Same bytes -> same frame id (the dup-delivery property).
+    assert codec.decode_frame_ex(frame)[3].crc == info.crc
+
+
+def test_fused_super_step_attribution():
+    """Typing runs fuse; their spans' flow.apply records name the
+    fused super-step that absorbed them (fstep / fn)."""
+    gen, rep = flow_run(workload="typing")
+    assert rep["flow"]["audit_ok"]
+    fused = [r for r in gen.server.flow.records
+             if r["k"] == "flow.apply" and "fstep" in r]
+    assert fused, "typing workload produced no fused attribution"
+    assert all(r["fn"] >= 1 and r["fstep"] >= 0 for r in fused)
+
+
+def test_buffer_pressure_drop_emits_flow_event():
+    from text_crdt_rust_tpu.common import RemoteId, RemoteIns, RemoteTxn
+    from text_crdt_rust_tpu.parallel.causal import CausalBuffer
+
+    root = RemoteId("ROOT", 0)
+    dropped = []
+    buf = CausalBuffer(max_pending=1)
+    buf.on_drop = dropped.append
+    # Two far-future txns: the second offer evicts the farthest.
+    for seq in (10, 20):
+        buf.add(RemoteTxn(RemoteId("a", seq), [root],
+                          [RemoteIns(root, root, "x")]))
+    assert len(dropped) == 1 and dropped[0].id.seq == 20
+    # The eviction chose the offer itself (farthest gap): the status
+    # must say so — "buffered" here would stamp a held event after
+    # on_drop already recorded the drop (review fix).
+    assert buf.last_offer == "dropped"
+    assert buf.pending == 1
+
+
+def test_divergence_bundle_joins_flow_path(tmp_path):
+    """ISSUE 11 satellite: the divergence post-mortem names the
+    diverged op's FULL path, not just the first diverging event."""
+    gen, _rep = flow_run(obs_dir=str(tmp_path))
+    world = gen.worlds[0]
+    doc = gen.server.doc_state(world.doc_id)
+    # Manufacture a divergence: one more server edit the twin never
+    # observes, then walk the first-divergence join.
+    gen.server.submit_local(world.doc_id, "rogue-editor", 0, 0, "Z")
+    gen.server.drain()
+    path = gen.server.recorder.on_divergence(
+        world.doc_id, doc.oracle, world.twin,
+        detail="test-manufactured divergence")
+    assert path is not None
+    bundle = json.load(open(path))
+    fd = bundle["first_divergence"]
+    assert fd["agent"] == "rogue-editor"
+    flow_path = bundle["flow_path"]
+    assert flow_path, "bundle carries no flow path"
+    assert {e["k"] for e in flow_path} >= {"flow.apply"}
+    assert all(e["agent"] == "rogue-editor" for e in flow_path)
+    gen.server.close_obs()
+
+
+def test_flow_path_includes_local_span_lk_records():
+    """Review fix: a local span's journey starts at its lk-keyed
+    emission — the divergence bundle's flow_path must include it, not
+    just the seq-carrying apply."""
+    from text_crdt_rust_tpu.obs.recorder import FlightRecorder
+    from text_crdt_rust_tpu.utils.metrics import Counters
+
+    tr = Tracer(ring=64, keep_all=True)
+    rec = FlightRecorder(tr, Counters(), "/tmp/unused_obs")
+    flow = FlowTracker(tr, sample_mod=1)
+    lk = flow.emit_local("d0", "editor", 3)
+    flow.applied("d0", "editor", 5, 3, "device", lk=lk)
+    path = rec.flow_path("d0", "editor", 6)
+    kinds = [e["k"] for e in path]
+    assert kinds == ["flow.emit", "flow.apply"]
+    assert path[0]["lk"] == lk and "seq" not in path[0]
+
+
+def test_chrome_export_links_flow_spans_with_arrows():
+    gen, _rep = flow_run(trace_keep=True)
+    doc = A.chrome_trace(gen.server.tracer.events)
+    phases = [e for e in doc["traceEvents"] if e.get("ph") in "stf"]
+    assert phases, "no flow arrows emitted"
+    by_id = {}
+    for e in phases:
+        by_id.setdefault(e["id"], []).append(e["ph"])
+    for fid, phs in by_id.items():
+        assert phs[0] == "s" and phs[-1] == "f", (fid, phs)
+    # Finish arrows bind to slice ends (Perfetto's bp rule).
+    assert all(e.get("bp") == "e" for e in phases if e["ph"] == "f")
+    # Flow lifecycle events render as (sub-µs) DURATION slices, not
+    # instants: the chrome format binds s/t/f arrows to an enclosing
+    # slice on the same pid/tid/ts — an instant would drop the arrow.
+    lifecycle = [e for e in doc["traceEvents"]
+                 if str(e.get("name", "")).startswith("flow.")]
+    assert lifecycle
+    assert all(e["ph"] == "X" and e["dur"] > 0 for e in lifecycle)
+
+
+def test_flow_block_determinism_across_runs():
+    """The flow census — being a pure function of the logical stream —
+    is byte-deterministic across same-seed runs at full sampling."""
+    _g1, rep1 = flow_run()
+    _g2, rep2 = flow_run()
+    assert rep1["flow"] == rep2["flow"]

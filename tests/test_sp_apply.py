@@ -318,7 +318,12 @@ class TestSpRemote:
         doc.apply_stream(compile_remote(txns))
         assert doc.expand().tolist() == oracle_signed(self._oracle(txns))
 
-    @pytest.mark.parametrize("seed", [3, 21])
+    # Seed 3 is slow-tier (ISSUE 11 budget satellite: ~15 s of
+    # interpret compile); seed 21 stays as the tier-1 representative,
+    # and test_fuzz_blocked's 50-seed sp-remote ride-along covers the
+    # surface in breadth.
+    @pytest.mark.parametrize("seed", [
+        pytest.param(3, marks=pytest.mark.slow), 21])
     def test_two_peer_merge_matches_rle_mixed(self, seed):
         # The VERDICT bar: sp-sharded remote apply equal to the
         # single-device rle_mixed engine's output on the same stream.

@@ -246,6 +246,17 @@ class ServeConfig:
     trace_keep: bool = False   # retain the full event list in memory
     #                            (the trace-determinism tests read it
     #                            back via Tracer.logical_bytes)
+    flow_sample_mod: int = 16  # per-op provenance spans (ISSUE 11,
+    #                            obs/flow): agents whose crc32(name) %
+    #                            mod == 0 get END-TO-END flow.* span
+    #                            events (emit/frame/reject/buffer/
+    #                            ready/apply on the logical tick axis).
+    #                            Per-AGENT sampling keeps every sampled
+    #                            span complete, so the conservation
+    #                            audit is valid at any mod.  1 = track
+    #                            everything (audit/ledger runs); 0 =
+    #                            off; the 16 default keeps the serve
+    #                            path under the PERF.md §14/§16 5% bar
     obs_dir: Optional[str] = None  # post-mortem bundle directory;
     #                            None = $TCR_TRACE_DIR or
     #                            <spool_dir>/obs

@@ -36,6 +36,7 @@ from ..common import (
     RemoteId,
     RemoteIns,
     RemoteTxn,
+    txn_len,
     validate_remote_txn,
 )
 from ..utils.integrity import crc32c
@@ -76,7 +77,19 @@ class CodecError(ValueError):
     """A frame failed validation (framing, CRC, version, or body shape).
 
     The recoverable rejection path: the session layer counts it and
-    re-requests the range; it must never surface as a crash."""
+    re-requests the range; it must never surface as a crash.
+
+    When the failure is txn-level (the frame decoded but a txn failed
+    ``validate_remote_txn``), ``agent``/``seq``/``n`` name the offending
+    span so the reject trace event can carry the op's identity (ISSUE 11
+    satellite) — structurally-undecodable frames leave them ``None``
+    (there is no span to name)."""
+
+    def __init__(self, message: str, *, agent=None, seq=None, n=None):
+        super().__init__(message)
+        self.agent = agent
+        self.seq = seq
+        self.n = n
 
 
 # -- varints -----------------------------------------------------------------
@@ -217,6 +230,21 @@ def _frame(payload: bytes, version: int = FRAME_VERSION) -> bytes:
     return bytes(out)
 
 
+class FrameInfo:
+    """Frame-layer metadata ``decode_frame_ex`` plumbs through to the
+    receiver (ISSUE 11): the stored CRC32C doubles as a content-derived
+    **frame id** — deterministic across same-seed runs, identical for a
+    dup-delivered frame — so per-op flow events can name WHICH frame
+    carried a span without any wire-format change."""
+
+    __slots__ = ("version", "crc", "length")
+
+    def __init__(self, version: int, crc: int, length: int):
+        self.version = version
+        self.crc = crc
+        self.length = length
+
+
 def _unframe(buf: bytes, offset: int) -> Tuple[int, bytes, int]:
     """Validate one frame at ``offset``; return
     ``(version, payload, next_offset)``."""
@@ -317,7 +345,10 @@ def _decode_txns(buf: bytes, cur: int, end: int) -> List[RemoteTxn]:
         try:
             validate_remote_txn(txn)
         except ValueError as e:
-            raise CodecError(f"invalid txn: {e}") from None
+            # Name the offending span: the frame's bytes were sound, so
+            # the op's identity is known and the reject can carry it.
+            raise CodecError(f"invalid txn: {e}", agent=tid.agent,
+                             seq=tid.seq, n=txn_len(txn)) from None
         txns.append(txn)
     if cur != end:
         raise CodecError(f"{end - cur} trailing bytes after txn batch")
@@ -374,7 +405,18 @@ def decode_frame(buf: bytes, offset: int = 0):
     (KIND_TXNS), a wants dict (KIND_REQUEST), or a ``(watermarks, digest)``
     pair (KIND_DIGEST). Raises ``CodecError`` on any malformed input.
     """
+    kind, value, next_offset, _info = decode_frame_ex(buf, offset)
+    return kind, value, next_offset
+
+
+def decode_frame_ex(buf: bytes, offset: int = 0):
+    """``decode_frame`` plus a ``FrameInfo`` fourth element: the
+    receiver-side frame-id plumb-through (the stored CRC32C, already
+    verified by ``_unframe``) for flow provenance and audit logs."""
     version, payload, next_offset = _unframe(buf, offset)
+    info = FrameInfo(version,
+                     struct.unpack_from("<I", buf, next_offset - 4)[0],
+                     next_offset - offset)
     if not payload:
         raise CodecError("empty payload")
     kind = payload[0]
@@ -386,24 +428,26 @@ def decode_frame(buf: bytes, offset: int = 0):
         from . import columnar
         if kind == KIND_TXNS:
             return KIND_TXNS, columnar.decode_txns(payload, cur, end), \
-                next_offset
+                next_offset, info
         if kind == KIND_TXNS_MUX:
             return KIND_TXNS_MUX, \
-                columnar.decode_txns_mux(payload, cur, end), next_offset
+                columnar.decode_txns_mux(payload, cur, end), \
+                next_offset, info
         raise CodecError(f"frame kind {kind} not defined for version 2")
     if kind == KIND_TXNS:
-        return KIND_TXNS, _decode_txns(payload, cur, end), next_offset
+        return KIND_TXNS, _decode_txns(payload, cur, end), \
+            next_offset, info
     if kind == KIND_REQUEST:
         wants, cur = _decode_name_map(payload, cur, end)
         if cur != end:
             raise CodecError("trailing bytes after request")
-        return KIND_REQUEST, wants, next_offset
+        return KIND_REQUEST, wants, next_offset, info
     if kind == KIND_DIGEST:
         marks, cur = _decode_name_map(payload, cur, end)
         if cur + 4 != end:
             raise CodecError("bad digest trailer")
         digest = struct.unpack_from("<I", payload, cur)[0]
-        return KIND_DIGEST, (marks, digest), next_offset
+        return KIND_DIGEST, (marks, digest), next_offset, info
     raise CodecError(f"unknown frame kind {kind}")
 
 

@@ -710,7 +710,10 @@ def _decode_txn_cols(chunks: Dict[int, Tuple[int, bytes]],
         try:
             validate_remote_txn(txn)
         except ValueError as e:
-            raise CodecError(f"invalid txn: {e}") from None
+            # Same span-naming contract as the row decoder: the bytes
+            # were sound, so the reject can carry the op's identity.
+            raise CodecError(f"invalid txn: {e}", agent=names[author],
+                             seq=seq, n=tlen) from None
         txns.append(txn)
         last_seq[author] = seq
         chain[author] = seq + tlen

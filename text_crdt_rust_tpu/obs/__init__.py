@@ -24,8 +24,16 @@ Five layers, each consumable on its own:
                      perf regression gate;
 - ``obs.analyze``  — trace analytics CLI: per-tick phase breakdown,
                      hot-doc and fusion tables, recompile timeline,
-                     two-trace logical diff, Chrome trace-event export.
+                     two-trace logical diff, Chrome trace-event export,
+                     per-op flow census + conservation audit;
+- ``obs.flow``     — per-op provenance spans (ISSUE 11): every sampled
+                     op's ``(agent, seq)`` journey emitted as
+                     ``flow.*`` trace events, with a conservation
+                     audit (leaked/double-applied spans are named
+                     findings) and op-age-at-apply distributions on
+                     the logical tick axis.
 """
+from .flow import FlowTracker, audit_spans, flow_report  # noqa: F401
 from .ledger import (  # noqa: F401
     LEDGER_SCHEMA_VERSION,
     diff_ledger,

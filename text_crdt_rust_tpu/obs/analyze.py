@@ -18,7 +18,13 @@ answers the questions a regression hunt or a post-mortem actually asks:
 - ``chrome``     — Chrome trace-event export (Perfetto-loadable): the
   segregated wall-clock spans laid over the LOGICAL tick axis, so a
   human can scrub a tick timeline even though the trace backbone is
-  causal, not temporal.
+  causal, not temporal — plus flow-event arrows (``ph: "s"/"t"/"f"``)
+  linking each sampled op's ``flow.*`` span across its tick phases;
+- ``flow``       — per-op provenance census (obs/flow.py): span
+  terminal states, op-age-at-apply distributions per popularity band
+  and fault class; ``--audit`` turns conservation into an exit code —
+  0 iff every emitted span is terminally accounted, else 1 naming the
+  first leaked/double-applied span.
 
 All analysis functions are pure (events in, dict out) so tests can
 golden them; the CLI renders text or ``--json``.  Inputs: trace JSONL
@@ -34,7 +40,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from .trace import WALL_KEY
 
@@ -232,7 +238,29 @@ def chrome_trace(events: Sequence[dict]) -> dict:
     out: List[dict] = []
     seen_pids = set()
     tick_idx: Dict[int, int] = {}
-    for ev in events:
+    # Flow arrows (ISSUE 11 satellite): each sampled op's flow.* events
+    # chain into one Perfetto flow — ph "s" at the first lifecycle
+    # event, "t" steps, "f" at the last — so one op's journey is
+    # visible ACROSS tick slots alongside the per-tick phase track.
+    # Span identity for the arrow id: (doc, agent, seq) for remote
+    # spans, (doc, agent, lk) for local ones.
+    flow_groups: Dict[tuple, List[int]] = {}
+    for idx, ev in enumerate(events):
+        if str(ev.get("k", "")).startswith("flow."):
+            key = (ev.get("doc"), ev.get("agent"),
+                   "lk", ev["lk"]) if "lk" in ev else \
+                  (ev.get("doc"), ev.get("agent"), "seq", ev.get("seq"))
+            flow_groups.setdefault(key, []).append(idx)
+    flow_mark: Dict[int, Tuple[str, str]] = {}
+    for key, idxs in flow_groups.items():
+        if len(idxs) < 2:
+            continue  # an arrow needs two ends
+        fid = "/".join(str(p) for p in key)
+        flow_mark[idxs[0]] = ("s", fid)
+        for j in idxs[1:-1]:
+            flow_mark[j] = ("t", fid)
+        flow_mark[idxs[-1]] = ("f", fid)
+    for idx, ev in enumerate(events):
         kind = ev.get("k", "?")
         pid = int(ev.get("shard", 0)) if isinstance(
             ev.get("shard"), int) else 0
@@ -252,11 +280,28 @@ def chrome_trace(events: Sequence[dict]) -> dict:
         wall = _wall_ms(ev)
         base = {"name": kind, "cat": kind.split(".")[0], "pid": pid,
                 "tid": kind, "ts": round(ts, 3), "args": args}
+        is_flow = kind.startswith("flow.")
         if wall > 0.0:
             out.append({**base, "ph": "X",
                         "dur": round(wall * 1e3, 3)})  # ms -> trace-µs
+        elif is_flow:
+            # Flow lifecycle events render as sub-µs duration slices,
+            # not instants: the chrome trace format binds s/t/f flow
+            # arrows to an ENCLOSING slice on the same pid/tid/ts — an
+            # instant gives the importer nothing to attach to and the
+            # arrows would be dropped.
+            out.append({**base, "ph": "X", "dur": 0.5})
         else:
             out.append({**base, "ph": "i", "s": "t"})
+        mark = flow_mark.get(idx)
+        if mark is not None:
+            ph, fid = mark
+            arrow = {"name": "op-flow", "cat": "flow", "pid": pid,
+                     "tid": kind, "ts": round(ts, 3), "ph": ph,
+                     "id": fid}
+            if ph == "f":
+                arrow["bp"] = "e"  # bind to the enclosing slice's end
+            out.append(arrow)
     return {"traceEvents": out, "displayTimeUnit": "ms",
             "otherData": {"tick_pitch_us": CHROME_TICK_US,
                           "note": "time axis is the LOGICAL tick axis; "
@@ -307,6 +352,15 @@ def main(argv=None) -> int:
     p.add_argument("trace", nargs="+")
     p.add_argument("-o", "--out", default=None,
                    help="output path (default: stdout)")
+    p = sub.add_parser("flow")
+    p.add_argument("trace", nargs="+",
+                   help="trace JSONL segment(s) or bundle JSON")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--audit", action="store_true",
+                   help="conservation audit: exit 1 unless every "
+                        "emitted span is terminally accounted "
+                        "(applied once / rejected / named in-flight "
+                        "location), naming the first finding")
     args = ap.parse_args(argv)
 
     if args.cmd == "diff":
@@ -365,6 +419,37 @@ def main(argv=None) -> int:
             print(f"{d['compiles']} compiles (last at tick "
                   f"{d['last_compile_tick']} of {d['run_last_tick']})")
             _print_table(d["timeline"], ["tick", "i", "shard", "bucket"])
+    elif args.cmd == "flow":
+        from .flow import flow_report
+
+        d = flow_report(events, expect_terminal=args.audit)
+        if args.json:
+            print(json.dumps(d, indent=1, sort_keys=True))
+        else:
+            sp = d["spans"]
+            print(f"{sp['emitted']} spans tracked ({d['flow_events']} "
+                  f"flow events): {sp['applied']} applied "
+                  f"({d['applies']['device']} device / "
+                  f"{d['applies']['host']} host), {sp['rejected']} "
+                  f"rejected, {sp['in_flight']} in flight")
+            a = d["ages_ticks"]
+            print(f"op age at apply (ticks): p50 {a['p50']} "
+                  f"p99 {a['p99']} max {a['max']} (n={a['count']})")
+            for group in ("by_band", "by_class"):
+                rows = [{"bucket": k, **v} for k, v in d[group].items()
+                        if v["count"]]
+                if rows:
+                    print(f"{group.replace('_', ' ')}:")
+                    _print_table(rows, ["bucket", "count", "p50",
+                                        "p99", "max"])
+        if args.audit and not d["audit_ok"]:
+            f = d["findings"][0]
+            print(f"CONSERVATION AUDIT FAILED: {f['kind']} — "
+                  f"{f['detail']}", file=sys.stderr)
+            return 1
+        if args.audit:
+            print(f"conservation audit OK: {d['spans']['emitted']} "
+                  f"spans terminally accounted", file=sys.stderr)
     return 0
 
 

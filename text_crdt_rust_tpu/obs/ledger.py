@@ -38,7 +38,7 @@ Ledger shape::
 
 Wall-clock data NEVER enters a cpu cell: the ledger is a logical cost
 contract, and wall histograms belong to the ``device`` cells the
-silicon re-record (``perf/when_up_r10.sh``) appends.
+silicon re-record (``perf/when_up_r11.sh``) appends.
 """
 from __future__ import annotations
 
@@ -64,6 +64,9 @@ METRIC_FAMILIES = (
     "fuse",         # generalized step-fusion accounting
     "hlo",          # static compiled-HLO costs (collectives/flops/bytes)
     "wall",         # device-cell wall histograms (silicon re-record only)
+    "flow",         # per-op provenance: span terminal states + op-age-
+    #                 at-apply in logical ticks (obs/flow, ISSUE 11) —
+    #                 the ROADMAP-7 pipelined-tick latency contract
 )
 
 CELL_KINDS = ("cpu", "device")

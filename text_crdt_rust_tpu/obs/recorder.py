@@ -20,7 +20,9 @@ bundle — a versioned JSON artifact carrying:
   compared item by item in document order, the first differing item
   named as peer-portable ``(agent, seq)``, and — joined against the
   recorder's per-doc apply log — the exact logical tick and trace
-  event that introduced it.
+  event that introduced it; with per-op provenance on (obs/flow), the
+  diverged span's FULL flow path (emit -> frame -> buffer -> ready ->
+  apply) rides along so the bundle names the op's whole journey.
 
 Trigger classes (``REASONS``): ``codec`` (`net/codec.CodecError`),
 ``causal-gap`` (`net/session.CausalGapError`), ``checkpoint``
@@ -112,6 +114,10 @@ class FlightRecorder:
         self.bundle_paths: List[str] = []
         self._dumped: Dict[str, int] = {}
         self._applies: Dict[str, deque] = {}
+        # Per-doc ring of flow.* provenance events (ISSUE 11): the
+        # divergence bundle joins the diverged span's FULL path —
+        # emit/frame/buffer/ready/apply — not just its apply record.
+        self._flows: Dict[str, deque] = {}
         self._frames: deque = deque(maxlen=max(1, frame_ring))
         # Last compiled-step metadata per doc (the batcher records it
         # right before the device pass).
@@ -124,7 +130,15 @@ class FlightRecorder:
     # -- feeds ---------------------------------------------------------------
 
     def _on_event(self, ev: dict) -> None:
-        if ev.get("k") != "apply":
+        kind = ev.get("k")
+        if isinstance(kind, str) and kind.startswith("flow."):
+            doc = ev.get("doc")
+            ring = self._flows.get(doc)
+            if ring is None:
+                ring = self._flows[doc] = deque(maxlen=self.apply_ring)
+            ring.append(ev)
+            return
+        if kind != "apply":
             return
         doc = ev["doc"]
         ring = self._applies.get(doc)
@@ -176,18 +190,49 @@ class FlightRecorder:
                               tick=tick, oracle=oracle, extra=extra)
         return self._write(bundle)
 
+    def flow_path(self, doc_id: str, agent: str,
+                  seq: int) -> List[dict]:
+        """Every retained flow.* event whose span covers ``(agent,
+        seq)`` for this doc, in emission order — the op's full journey
+        (emit -> frame -> buffer/ready -> apply) as far as the bounded
+        ring still holds it.  Local spans have no seq until apply, so
+        a covering apply's ``lk`` pulls in the span's ordinal-keyed
+        records (the emission, any invalid-position reject) too."""
+        ring = list(self._flows.get(doc_id, ()))
+        out = []
+        lks = set()
+        for ev in ring:
+            if ev.get("agent") != agent or "seq" not in ev:
+                continue
+            s = int(ev["seq"])
+            if s <= seq < s + max(int(ev.get("n", 1)), 1):
+                out.append(ev)
+                if "lk" in ev:
+                    lks.add(ev["lk"])
+        if lks:
+            out.extend(ev for ev in ring
+                       if "seq" not in ev and ev.get("lk") in lks
+                       and ev.get("agent") == agent)
+            out.sort(key=lambda ev: ev["i"])
+        return out
+
     def on_divergence(self, doc_id: str, server_oracle, twin_oracle, *,
                       detail: str = "twin-check bit-identity mismatch",
                       tick: Optional[int] = None) -> Optional[str]:
         """The divergence post-mortem: first-divergence walk + apply-log
         join, then a bundle.  This is the artifact that answers *when*
         — the exact logical tick, doc, and event where the twin first
-        diverged (ISSUE 8 acceptance)."""
+        diverged (ISSUE 8 acceptance).  When per-op provenance was on
+        (obs/flow), the bundle also carries the diverged span's FULL
+        flow path (ISSUE 11 satellite) — not just the apply that
+        introduced the item, but its whole journey into the server."""
         fd = first_divergence(server_oracle, twin_oracle)
         extra = {"first_divergence": fd}
         if fd is not None:
             extra["apply_event"] = self.find_apply(doc_id, fd["agent"],
                                                    fd["seq"])
+            extra["flow_path"] = self.flow_path(doc_id, fd["agent"],
+                                                fd["seq"])
         return self.on_failure(REASON_DIVERGENCE, detail, doc_id=doc_id,
                                tick=tick, oracle=server_oracle,
                                extra=extra)

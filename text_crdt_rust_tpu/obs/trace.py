@@ -44,6 +44,10 @@ kind                 emitted by / meaning
                      (or a twin/lane bit-identity mismatch)
 ``resync.round``     session/router: anti-entropy round (wants emitted)
 ``profile``          serve: jax.profiler capture started/stopped
+``flow.*``           per-op provenance spans (obs/flow.py): emit /
+                     frame / reject / buffer / ready / apply — one
+                     ``(agent, seq)`` span's journey through the
+                     serving loop, agent-sampled
 ===================  =======================================================
 """
 from __future__ import annotations
@@ -73,6 +77,17 @@ EVENT_SCHEMA: Dict[str, Tuple[str, ...]] = {
     "divergence": ("doc",),
     "resync.round": ("wants",),
     "profile": ("action",),
+    # Per-op provenance spans (ISSUE 11, obs/flow.py): one op's journey
+    # on the logical tick axis.  Remote spans carry (agent, seq, n);
+    # local edits carry a per-doc ordinal ``lk`` until the oracle
+    # realizes their seq at apply.  The floor requires doc+agent — seq
+    # vs lk is the span-identity split the flow module owns.
+    "flow.emit": ("doc", "agent", "n"),
+    "flow.frame": ("doc", "agent", "seq", "n", "frame"),
+    "flow.reject": ("doc", "agent", "reason"),
+    "flow.buffer": ("doc", "agent", "seq", "n", "state"),
+    "flow.ready": ("doc", "agent", "seq", "n"),
+    "flow.apply": ("doc", "agent", "seq", "n", "mode"),
 }
 
 # The one reserved envelope key wall-clock data lives under; stripping

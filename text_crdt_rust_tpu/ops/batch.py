@@ -528,12 +528,20 @@ FUSE_SHAPES = ("typing", "sweep", "replace", "burst",
 
 @dataclasses.dataclass
 class FuseStats:
-    """Per-shape accounting of one ``fuse_steps`` pass."""
+    """Per-shape accounting of one ``fuse_steps`` pass.
+
+    ``step_map`` maps each INPUT step index to the OUTPUT (fused) step
+    that absorbed it — monotone non-decreasing, length ``steps_in`` —
+    so a caller that knows which input rows an op compiled into can
+    name the fused super-step the op landed in (the obs/flow per-op
+    provenance join).  ``None`` until a ``fuse_steps`` pass fills it;
+    ``merge`` drops it (per-stream maps don't concatenate)."""
 
     steps_in: int = 0
     steps_out: int = 0
     fused: Dict[str, int] = dataclasses.field(
         default_factory=lambda: {s: 0 for s in FUSE_SHAPES})
+    step_map: Optional[List[int]] = None
 
     @property
     def rows_saved(self) -> int:
@@ -552,6 +560,7 @@ class FuseStats:
     def merge(self, other: "FuseStats") -> None:
         self.steps_in += other.steps_in
         self.steps_out += other.steps_out
+        self.step_map = None  # per-stream; meaningless across merges
         for k, v in other.fused.items():
             self.fused[k] = self.fused.get(k, 0) + v
 
@@ -784,16 +793,27 @@ def fuse_steps(ops: OpTensors, lmax: Optional[int] = None,
                  ins_order_start=r.st, order_advance=r.order_advance,
                  rank=r.rank, rows=r.w, content=content)
 
+    step_map = [0] * stats.steps_in
+    cur_inputs = [0]
+    emitted_n = 0
     cur = row(0)
     for i in range(1, stats.steps_in):
         nxt = row(i)
         shape = _try_fuse(cur, nxt, lmax, fuse_w, dmax)
         if shape is None:
+            for j in cur_inputs:
+                step_map[j] = emitted_n
+            emitted_n += 1
             emit(cur)
             cur = nxt
+            cur_inputs = [i]
         else:
             stats.fused[shape] += 1
+            cur_inputs.append(i)
+    for j in cur_inputs:
+        step_map[j] = emitted_n
     emit(cur)
+    stats.step_map = step_map
     fused = out.to_tensors()
     stats.steps_out = fused.num_steps
     assert (int(np.asarray(fused.order_advance, dtype=np.int64).sum())

@@ -58,6 +58,18 @@ class CausalBuffer:
         # Agent -> end seq of the farthest evicted txn: keeps the gap
         # visible to missing() until redelivery covers it.
         self._evicted_ends: Dict[str, int] = {}
+        # What happened to the LAST ``add`` offer — "released" (the
+        # offered span's watermark advanced past it), "buffered"
+        # (held on a causal gap), "dropped" (pressure-evicted within
+        # this very offer — it left the buffer, on_drop already saw
+        # it), or "dup" (fully known / superseded).  Per-op provenance
+        # (obs/flow) reads this right after ``add`` to stamp the
+        # span's buffer-vs-ready lifecycle event.
+        self.last_offer = "dup"
+        # Optional pressure-eviction observer: called with the evicted
+        # txn (the span leaves the buffer but NOT the ledger — the gap
+        # stays visible to missing() and redelivery brings it back).
+        self.on_drop = None
 
     def _watermark(self, agent: str) -> int:
         return self._next_seq.get(agent, 0)
@@ -84,11 +96,20 @@ class CausalBuffer:
             return split_txn_suffix(txn, wm - txn.id.seq)
         return txn
 
+    def _offer_status(self, trimmed: RemoteTxn) -> str:
+        """Post-drain fate of the offered span: released iff the
+        author's watermark walked past its start seq (it — or a
+        superseding delivery — came out of the drain)."""
+        return ("released"
+                if self._watermark(trimmed.id.agent) > trimmed.id.seq
+                else "buffered")
+
     def add(self, txn: RemoteTxn) -> List[RemoteTxn]:
         """Offer one txn; return every txn that is now ready, causal order."""
         trimmed = self._trim(txn)
         if trimmed is None:
             self.duplicates_dropped += 1
+            self.last_offer = "dup"
             return []
         # Re-delivery of a still-blocked txn (peers re-sync while a parent
         # is missing) must not grow the buffer: one entry per (agent, seq),
@@ -97,8 +118,11 @@ class CausalBuffer:
             if held.id == trimmed.id:
                 if txn_len(trimmed) > txn_len(held):
                     self._pending[i] = trimmed
-                    return self._drain()
+                    released = self._drain()
+                    self.last_offer = self._offer_status(trimmed)
+                    return released
                 self.duplicates_dropped += 1
+                self.last_offer = "dup"
                 return []
         self._pending.append(trimmed)
         self.high_water = max(self.high_water, len(self._pending))
@@ -106,6 +130,15 @@ class CausalBuffer:
         if (self.max_pending is not None
                 and len(self._pending) > self.max_pending):
             self._evict()
+        status = self._offer_status(trimmed)
+        if status == "buffered" and all(h.id != trimmed.id
+                                        for h in self._pending):
+            # The eviction above chose the offer itself (it had the
+            # farthest watermark gap): it is NOT held — reporting
+            # "buffered" would stamp a held event after on_drop
+            # already recorded the drop.
+            status = "dropped"
+        self.last_offer = status
         return released
 
     def _evict(self) -> None:
@@ -123,6 +156,8 @@ class CausalBuffer:
         self._evicted_ends[agent] = max(self._evicted_ends.get(agent, 0),
                                         end)
         self.evictions += 1
+        if self.on_drop is not None:
+            self.on_drop(evicted)
 
     def add_all(self, txns: Iterable[RemoteTxn]) -> List[RemoteTxn]:
         out: List[RemoteTxn] = []

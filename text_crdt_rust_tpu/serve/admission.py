@@ -104,25 +104,42 @@ class AdmissionControl:
         self.global_pending = 0
         self._buckets: Dict[str, TokenBucket] = {}
 
-    def _reject(self, reason: str, detail: str) -> AdmissionError:
+    def _reject(self, reason: str, detail: str, *,
+                doc: Optional[str] = None, agent: Optional[str] = None,
+                seq: Optional[int] = None,
+                n: Optional[int] = None) -> AdmissionError:
         self.counters.incr(f"rejected_{reason.replace('-', '_')}")
         if self.tracer is not None:
-            self.tracer.event("admission.reject", reason=reason)
+            # The offending (agent, seq) range rides the reject event
+            # (ISSUE 11 satellite) — today's triage gets the op's
+            # identity, not just the reason class.  Absent for refusals
+            # with no decodable span (corrupt frames, unknown docs).
+            span = {k: v for k, v in (("doc", doc), ("agent", agent),
+                                      ("seq", seq), ("n", n))
+                    if v is not None}
+            self.tracer.event("admission.reject", reason=reason, **span)
         return AdmissionError(reason, detail)
 
-    def reject_frame(self, detail: str) -> AdmissionError:
+    def reject_frame(self, detail: str, *, doc: Optional[str] = None,
+                     agent: Optional[str] = None,
+                     seq: Optional[int] = None,
+                     n: Optional[int] = None) -> AdmissionError:
         """Typed refusal for undecodable wire bytes (the router calls
-        this from its ``CodecError`` handler so the count lives here)."""
-        return self._reject(REASON_FRAME_REJECTED, detail)
+        this from its ``CodecError`` handler so the count lives here);
+        span kwargs carry the offending op when the decoder could name
+        one (txn-level validation failures)."""
+        return self._reject(REASON_FRAME_REJECTED, detail, doc=doc,
+                            agent=agent, seq=seq, n=n)
 
     def reject_unknown_doc(self, doc_id: str) -> AdmissionError:
         return self._reject(REASON_DOC_UNKNOWN,
                             f"doc {doc_id!r} was never admitted")
 
     def admit(self, doc_id: str, agent: str, items: int,
-              doc_pending: int, tick: int) -> None:
+              doc_pending: int, tick: int,
+              seq: Optional[int] = None) -> None:
         """Gate AND count one event. Single-event submission path."""
-        self.check(doc_id, agent, items, doc_pending, tick)
+        self.check(doc_id, agent, items, doc_pending, tick, seq=seq)
         self.count_admitted(items)
 
     def count_admitted(self, items: int) -> None:
@@ -130,27 +147,30 @@ class AdmissionControl:
         self.counters.incr("admitted_items", items)
 
     def check(self, doc_id: str, agent: str, items: int,
-              doc_pending: int, tick: int) -> None:
+              doc_pending: int, tick: int,
+              seq: Optional[int] = None) -> None:
         """Gate one event (``items`` = its item count) WITHOUT counting
         it admitted — multi-event frames check every event first, then
         count+enqueue, so a mid-frame refusal enqueues nothing (rate
         tokens of the checked prefix are consumed; queue/count state is
-        untouched). Raises a typed ``AdmissionError``."""
+        untouched). Raises a typed ``AdmissionError``.  ``seq`` (the
+        span start for remote txns) rides the reject trace event."""
+        span = dict(doc=doc_id, agent=agent, seq=seq, n=items)
         if items > self.max_txn_len:
             raise self._reject(
                 REASON_FRAME_REJECTED,
                 f"event of {items} items exceeds max_txn_len "
-                f"{self.max_txn_len} (split the edit)")
+                f"{self.max_txn_len} (split the edit)", **span)
         if doc_pending >= self.max_queue_per_doc:
             raise self._reject(
                 REASON_QUEUE_FULL,
                 f"doc {doc_id!r} has {doc_pending} pending events "
-                f"(bound {self.max_queue_per_doc})")
+                f"(bound {self.max_queue_per_doc})", **span)
         if self.global_pending >= self.max_queue_global:
             raise self._reject(
                 REASON_QUEUE_FULL,
                 f"{self.global_pending} events pending server-wide "
-                f"(bound {self.max_queue_global})")
+                f"(bound {self.max_queue_global})", **span)
         if self.rate_capacity > 0:
             bucket = self._buckets.get(agent)
             if bucket is None:
@@ -160,7 +180,8 @@ class AdmissionControl:
                 raise self._reject(
                     REASON_RATE_LIMITED,
                     f"agent {agent!r} exhausted its token bucket "
-                    f"({self.rate_capacity} cap, {self.rate_refill}/tick)")
+                    f"({self.rate_capacity} cap, {self.rate_refill}"
+                    f"/tick)", **span)
 
     def enqueued(self) -> None:
         self.global_pending += 1

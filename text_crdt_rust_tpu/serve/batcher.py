@@ -250,7 +250,7 @@ class ContinuousBatcher:
                  step_buckets: Tuple[int, ...], lmax: int,
                  counters: Optional[Counters] = None,
                  fuse_steps: bool = False, fuse_w: int = 1,
-                 tracer=None, recorder=None):
+                 tracer=None, recorder=None, flow=None):
         assert tuple(sorted(step_buckets)) == tuple(step_buckets)
         self.router = router
         self.residency = residency
@@ -259,6 +259,7 @@ class ContinuousBatcher:
         self.counters = counters if counters is not None else Counters()
         self.tracer = tracer
         self.recorder = recorder
+        self.flow = flow  # obs/flow.FlowTracker (None = provenance off)
         # Generalized tick-stream fusion (``ops.batch.fuse_steps``,
         # ISSUE 6): each lane doc's drained tick stream is fused before
         # the capacity probe and stacking — typing runs / sweeps /
@@ -314,6 +315,12 @@ class ContinuousBatcher:
         live = len(oracle)
         if pos > live or pos + del_len > live:
             self.counters.incr("events_invalid")
+            if self.flow is not None and event.lk is not None:
+                # Terminal typed refusal for the span: the edit raced a
+                # position the server never reached (deterministically
+                # dropped — the loadgen's twin-sourced positions).
+                self.flow.rejected(doc.doc_id, agent, "invalid-position",
+                                   lk=event.lk)
             return False, None
         self._grow_table(doc, [agent])
         aid = oracle.get_or_create_agent_id(agent)
@@ -322,6 +329,10 @@ class ContinuousBatcher:
         oracle.apply_local_txn(aid, [LocalOp(pos=pos, ins_content=ins,
                                              del_span=del_len)])
         doc.assigner.assign(doc.table.id_of(agent), seq0, event.items)
+        # Realize the span for the tick's terminal flow.apply stamp
+        # (mode — device vs host — is only known after the lane-
+        # capacity probe, so the batcher stamps it there).
+        event.span = (agent, seq0, event.items)
         if self.tracer is not None:
             # The event-level audit log the divergence post-mortem
             # joins against: WHICH (agent, seq) span landed on WHICH
@@ -346,9 +357,17 @@ class ContinuousBatcher:
         if not txn_refs_known(doc.oracle, txn):
             self.counters.incr("txns_rejected")
             doc.buffer.rollback_watermark(txn.id.agent, txn.id.seq)
+            if self.flow is not None:
+                # Non-terminal when honest redelivery lands later (the
+                # rollback re-opens the watermark for it); terminal for
+                # a genuinely bogus peer txn.
+                self.flow.rejected(doc.doc_id, txn.id.agent,
+                                   "refs-unknown", seq=txn.id.seq,
+                                   n=event.items)
             return False, None
         self._grow_table(doc, ShardRouter.txn_agent_names(txn))
         doc.oracle.apply_remote_txn(txn)
+        event.span = (txn.id.agent, txn.id.seq, event.items)
         if self.tracer is not None:
             self.tracer.event("apply", doc=doc.doc_id, ev="txn",
                               agent=txn.id.agent, seq=txn.id.seq,
@@ -379,14 +398,20 @@ class ContinuousBatcher:
                 if n not in doc.table]
 
     def _drain_doc(self, doc: DocState, budget: int, compile_device: bool
-                   ) -> Tuple[Optional[B.OpTensors], List[Event], int]:
+                   ) -> Tuple[Optional[B.OpTensors], List[Event], int,
+                              List[Optional[Tuple[int, int]]]]:
         """Drain up to ``budget`` compiled steps of FIFO events from one
         doc: oracle-apply each, compile each (lane docs only), concat.
-        Returns (tick stream or None, APPLIED events, steps) — rejected
-        or invalid events are dequeued but excluded from ``applied`` so
-        they feed neither the ops-applied stats nor latency samples."""
+        Returns (tick stream or None, APPLIED events, steps, per-event
+        compiled step ranges) — rejected or invalid events are dequeued
+        but excluded from ``applied`` so they feed neither the
+        ops-applied stats nor latency samples.  ``ranges[i]`` is applied
+        event i's [s0, s1) row span in the concatenated tick stream
+        (None for host-only drains), the pre-fusion coordinates the
+        fuser's ``step_map`` translates to fused super-steps."""
         streams: List[B.OpTensors] = []
         applied: List[Event] = []
+        ranges: List[Optional[Tuple[int, int]]] = []
         steps = 0
         while doc.events:
             event = doc.events[0]
@@ -422,13 +447,37 @@ class ContinuousBatcher:
                 continue
             applied.append(event)
             if compile_device and ops is not None and ops.num_steps > 0:
+                ranges.append((steps, steps + ops.num_steps))
                 streams.append(ops)
                 steps += ops.num_steps
-            elif not compile_device:
-                steps += est  # budget proxy: bounds host-side drain too
+            else:
+                ranges.append(None)
+                if not compile_device:
+                    steps += est  # budget proxy: bounds host drain too
         if not streams:
-            return None, applied, steps if compile_device else 0
-        return B.concat_ops(streams), applied, steps
+            return None, applied, steps if compile_device else 0, ranges
+        return B.concat_ops(streams), applied, steps, ranges
+
+    def _flow_applies(self, doc: DocState, applied: List[Event],
+                      ranges, fs, device: bool) -> None:
+        """Stamp the terminal ``flow.apply`` for every span a doc's
+        tick drain applied: realized ``(agent, seq, n)`` from the
+        event, device-vs-host mode from the probe outcome, and — when
+        the tick stream fused — the fused super-step that absorbed the
+        span's compiled rows (``FuseStats.step_map`` translated through
+        the event's pre-fusion row range)."""
+        mode = "device" if device else "host"
+        fmap = fs.step_map if fs is not None else None
+        for event, rng in zip(applied, ranges):
+            if event.span is None:
+                continue
+            agent, seq, n = event.span
+            fstep = fn = None
+            if fmap is not None and rng is not None:
+                fstep = fmap[rng[0]]
+                fn = fmap[rng[1] - 1] - fstep + 1
+            self.flow.applied(doc.doc_id, agent, seq, n, mode,
+                              lk=event.lk, fstep=fstep, fn_steps=fn)
 
     # -- the tick -----------------------------------------------------------
 
@@ -469,7 +518,7 @@ class ContinuousBatcher:
                     continue
                 if not doc.resident:
                     continue  # restore deferred (no lane, no memory)
-                stream, applied, steps = self._drain_doc(
+                stream, applied, steps, ev_ranges = self._drain_doc(
                     doc, budget, compile_device=doc.in_lane)
                 applied_events.extend(applied)
                 stats["events_applied"] += len(applied)
@@ -497,6 +546,7 @@ class ContinuousBatcher:
                         # measure the whole run, not the fused subset.
                         fs = B.FuseStats(steps_in=stream.num_steps,
                                          steps_out=stream.num_steps)
+                scheduled = False
                 if doc.in_lane and stream is not None:
                     # Lane-capacity probe AFTER the oracle applied (the
                     # oracle is truth): overflow degrades to host-only,
@@ -505,6 +555,7 @@ class ContinuousBatcher:
                     # run rows + split headroom for the blocked lanes).
                     probed += 1
                     if backend.tick_fits(doc.lane, doc.oracle, stream):
+                        scheduled = True
                         if self.step_trace is not None:
                             self.step_trace(doc.doc_id, stream)
                         lane_streams[doc.lane] = stream
@@ -540,6 +591,14 @@ class ContinuousBatcher:
                                  f"{backend.order_capacity}")
                 elif not doc.in_lane and applied:
                     host_only_applies += 1
+                if self.flow is not None and applied:
+                    # Terminal flow.apply per span, stamped AFTER the
+                    # capacity probe so the mode is truthful: a probe
+                    # failure means the oracle applied but no device
+                    # step ran — "host", exactly like host-only docs.
+                    self._flow_applies(doc, applied, ev_ranges,
+                                       fs if scheduled else None,
+                                       scheduled)
 
             if tr is not None and (shard_events or shard_steps):
                 tr.event("tick.drain", shard=shard, events=shard_events,

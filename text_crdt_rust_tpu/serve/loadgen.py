@@ -411,6 +411,11 @@ class ServeLoadGen:
                                         self.rng.randint(1, 3),
                                         workload=self.workload)
                 fresh = world.record(txns)
+                # Per-op provenance (ISSUE 11): a span is EMITTED the
+                # moment it exists — before the fault channel gets to
+                # eat its frames — so the conservation audit covers
+                # lost-and-repulled ops, not just delivered ones.
+                self.server.flow.emit_txns(world.doc_id, fresh)
                 world.feed_twin(fresh)
                 ops = sum(txn_len(t) for t in fresh)
                 self.ops_offered += ops
@@ -508,6 +513,11 @@ class ServeLoadGen:
             },
             # Observability block (ISSUE 8): everything below flows
             # from the ONE metrics registry + tracer the server owns.
+            # Per-op provenance (ISSUE 11): span census, conservation
+            # audit over the sampled spans (end-of-run mode: every
+            # span must be terminal — the drain above finished), and
+            # op-age-at-apply distributions in logical ticks.
+            "flow": self.server.flow_summary(expect_terminal=True),
             "obs": {
                 "trace_enabled": self.cfg.trace,
                 "trace_schema": TRACE_SCHEMA_VERSION,
@@ -626,6 +636,12 @@ def main(argv=None) -> None:
     ap.add_argument("--trace-rotate-bytes", type=int, default=None,
                     help="size-cap per trace segment; the stream rolls "
                          "to <path>.1, <path>.2, ... at the cap")
+    ap.add_argument("--flow-sample-mod", type=int,
+                    default=d.flow_sample_mod,
+                    help="per-op provenance sampling: agents with "
+                         "crc32(name) %% mod == 0 get end-to-end "
+                         "flow.* span events (1 = every span, the "
+                         "conservation-audit mode; 0 = off)")
     ap.add_argument("--profile-dir", default=None,
                     help="opt-in jax.profiler capture directory "
                          "(ticks 1..profile_ticks)")
@@ -641,6 +657,7 @@ def main(argv=None) -> None:
                       wire_format=a.wire, ckpt_format=a.ckpt,
                       trace=not a.no_trace, trace_path=a.trace_path,
                       trace_rotate_bytes=a.trace_rotate_bytes,
+                      flow_sample_mod=a.flow_sample_mod,
                       profile_dir=a.profile_dir)
     gen = ServeLoadGen(docs=a.docs, agents_per_doc=a.agents, ticks=a.ticks,
                        events_per_tick=a.events_per_tick, zipf_alpha=a.zipf,

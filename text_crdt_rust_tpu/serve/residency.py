@@ -210,6 +210,12 @@ class LaneResidency:
             # blended mean.
             self.counters.sample(f"ckpt_{info['kind']}_bytes_per_evict",
                                  info["bytes"])
+        # Conservation checkpoint (ISSUE 11): the doc's item/order
+        # counts at the eviction boundary.  The flow audit pairs these
+        # with the restore's — a checkpoint replay that re-APPLIED
+        # history (instead of re-creating state) would inflate them.
+        n_items = doc.oracle.n
+        n_orders = doc.oracle.get_next_order()
         doc.ckpt_path = path
         doc.oracle = None
         doc.table = None
@@ -219,7 +225,8 @@ class LaneResidency:
         self.counters.incr("evictions")
         if self.tracer is not None:
             self.tracer.event("residency.evict", doc=doc.doc_id,
-                              ckpt=info["kind"], bytes=info.get("bytes", 0))
+                              ckpt=info["kind"], bytes=info.get("bytes", 0),
+                              n=n_items, orders=n_orders)
         return path
 
     def restore(self, doc: DocState, tick_no: Optional[int] = None) -> None:
@@ -255,7 +262,12 @@ class LaneResidency:
             doc.last_touch_tick = tick_no
         self.counters.incr("restores")
         if self.tracer is not None:
-            self.tracer.event("residency.restore", doc=doc.doc_id)
+            # The restore side of the conservation pair: queued events
+            # replay AFTER this through normal ticks, so these counts
+            # must equal the eviction snapshot's exactly.
+            self.tracer.event("residency.restore", doc=doc.doc_id,
+                              n=oracle.n,
+                              orders=oracle.get_next_order())
 
     # -- verification --------------------------------------------------------
 

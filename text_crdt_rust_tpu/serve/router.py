@@ -55,18 +55,29 @@ class Event:
     """One unit of causally-ready work. ``t_submit`` is the ADMISSION
     time (callers pass the stamp recorded when the txn entered the
     server, so a txn's causal-buffer wait — the fault-induced tail the
-    latency metric exists to expose — is inside admission->applied)."""
+    latency metric exists to expose — is inside admission->applied).
 
-    __slots__ = ("kind", "payload", "items", "t_submit", "tick_submit")
+    ``lk`` is the flow-provenance ordinal of a sampled LOCAL edit
+    (obs/flow: the span has no ``(agent, seq)`` until the oracle
+    applies it); ``span`` is filled by the batcher at apply time with
+    the realized ``(agent, seq, n)`` so the tick can stamp the span's
+    terminal ``flow.apply`` after the lane-capacity probe decides
+    device vs host."""
+
+    __slots__ = ("kind", "payload", "items", "t_submit", "tick_submit",
+                 "lk", "span")
 
     def __init__(self, kind: str, payload, items: int, tick: int,
-                 t_submit: Optional[float] = None):
+                 t_submit: Optional[float] = None,
+                 lk: Optional[int] = None):
         self.kind = kind
         self.payload = payload
         self.items = items
         self.t_submit = (time.perf_counter() if t_submit is None
                          else t_submit)
         self.tick_submit = tick
+        self.lk = lk
+        self.span = None
 
 
 class DocState:
@@ -141,12 +152,13 @@ class ShardRouter:
     def __init__(self, num_shards: int, *, admission: AdmissionControl,
                  counters: Optional[Counters] = None,
                  buffer_max_pending: Optional[int] = 512,
-                 wire_format: str = "row", tracer=None):
+                 wire_format: str = "row", tracer=None, flow=None):
         assert num_shards >= 1
         self.num_shards = num_shards
         self.admission = admission
         self.counters = counters if counters is not None else Counters()
         self.tracer = tracer
+        self.flow = flow  # obs/flow.FlowTracker (None = provenance off)
         self.recorder = None  # set by DocServer after construction
         self.buffer_max_pending = buffer_max_pending
         # TXNS frames the router EMITS (serving REQUEST pulls); decode
@@ -173,6 +185,12 @@ class ShardRouter:
         shard = min(range(self.num_shards), key=lambda s: self._shard_docs[s])
         doc = DocState(doc_id, shard, max_pending=self.buffer_max_pending)
         doc.last_touch_tick = self._tick
+        if self.flow is not None and self.flow.enabled:
+            # A pressure-evicted buffer txn leaves the process but not
+            # the ledger: stamp the drop so the span's location stays
+            # named until redelivery brings it back.
+            doc.buffer.on_drop = (
+                lambda txn, d=doc_id: self.flow.buffered(d, txn, "drop"))
         self.docs[doc_id] = doc
         self._shard_docs[shard] += 1
         self.counters.incr("docs_admitted")
@@ -230,6 +248,11 @@ class ShardRouter:
         ADMISSION stamps (a release must never be refused — refusing it
         would desync the buffer watermark)."""
         for txn in released:
+            if self.flow is not None:
+                # The ONE choke point every causal release crosses
+                # (submit-time drains AND tick-end watermark advances):
+                # the span's buffered->ready crossing.
+                self.flow.ready(doc.doc_id, txn)
             self._enqueue(doc, Event(EV_TXN, txn, txn_len(txn), self._tick,
                                      t_submit=self._pop_stamp(doc, txn)))
 
@@ -238,15 +261,35 @@ class ShardRouter:
         queue. Raises ``AdmissionError``; on success the txn is either
         released into the event FIFO or held in the causal buffer."""
         doc = self.doc(doc_id)
-        self.admission.admit(doc_id, txn.id.agent, txn_len(txn),
-                             doc.pending(), self._tick)
+        try:
+            self.admission.admit(doc_id, txn.id.agent, txn_len(txn),
+                                 doc.pending(), self._tick,
+                                 seq=txn.id.seq)
+        except AdmissionError as e:
+            self._flow_reject_txns(doc_id, [txn], e.reason)
+            raise
         self._ingest_txn(doc, txn)
+
+    def _flow_reject_txns(self, doc_id: Optional[str],
+                          txns: List[RemoteTxn], reason: str) -> None:
+        """Stamp ``flow.reject`` for every sampled span an admission
+        refusal bounced (all-or-nothing per frame/group, so the whole
+        batch shares the reason).  Non-terminal if a redelivery later
+        lands — the audit's precedence gives applied the last word."""
+        if self.flow is None:
+            return
+        for t in txns:
+            self.flow.rejected(doc_id, t.id.agent, reason,
+                               seq=t.id.seq, n=txn_len(t))
 
     def _ingest_txn(self, doc: DocState, txn: RemoteTxn) -> None:
         doc.submit_stamps.setdefault((txn.id.agent, txn.id.seq),
                                      time.perf_counter())
         self._prune_stamps(doc)
         released = doc.buffer.add(txn)
+        if (self.flow is not None
+                and doc.buffer.last_offer == "buffered"):
+            self.flow.buffered(doc.doc_id, txn, "held")
         doc.last_touch_tick = self._tick
         self.enqueue_released(doc, released)
 
@@ -257,10 +300,20 @@ class ShardRouter:
         if items <= 0:
             return
         doc = self.doc(doc_id)
-        self.admission.admit(doc_id, agent, items, doc.pending(),
-                             self._tick)
+        # Emission precedes admission: a refused local edit is still an
+        # emitted span — its terminal state is the typed rejection.
+        lk = (self.flow.emit_local(doc_id, agent, items)
+              if self.flow is not None else None)
+        try:
+            self.admission.admit(doc_id, agent, items, doc.pending(),
+                                 self._tick)
+        except AdmissionError as e:
+            if lk is not None:
+                self.flow.rejected(doc_id, agent, e.reason, lk=lk)
+            raise
         self._enqueue(doc, Event(EV_LOCAL, (agent, pos, del_len,
-                                            ins_content), items, self._tick))
+                                            ins_content), items,
+                                 self._tick, lk=lk))
 
     def submit_frame(self, doc_id: str, data: bytes) -> List[bytes]:
         """Ingest one wire frame for ``doc_id``; returns response frames
@@ -271,20 +324,33 @@ class ShardRouter:
         if self.recorder is not None:
             self.recorder.note_frame(doc_id, data)
         try:
-            kind, value, _ = codec.decode_frame(data)
+            kind, value, _, finfo = codec.decode_frame_ex(data)
         except CodecError as e:
             self._trace_codec_reject(doc_id, e)
-            raise self.admission.reject_frame(str(e)) from None
+            raise self.admission.reject_frame(
+                str(e), doc=doc_id, agent=e.agent, seq=e.seq,
+                n=e.n) from None
         self.counters.incr("frames_received")
 
         if kind == codec.KIND_TXNS:
+            if self.flow is not None:
+                # The framed crossing, stamped with the frame's stored
+                # CRC32C as frame id (content-derived, so same-seed
+                # runs — and dup deliveries — agree on it).
+                self.flow.framed(doc_id, value, finfo.crc)
             # Two-phase: admission-CHECK every txn in the frame first,
             # then ingest — a mid-frame refusal must not leave a prefix
             # enqueued behind a raised AdmissionError (all-or-nothing
             # per frame; checked-prefix rate tokens are consumed).
-            for i, txn in enumerate(value):
-                self.admission.check(doc_id, txn.id.agent, txn_len(txn),
-                                     doc.pending() + i, self._tick)
+            try:
+                for i, txn in enumerate(value):
+                    self.admission.check(doc_id, txn.id.agent,
+                                         txn_len(txn),
+                                         doc.pending() + i, self._tick,
+                                         seq=txn.id.seq)
+            except AdmissionError as e:
+                self._flow_reject_txns(doc_id, value, e.reason)
+                raise
             for txn in value:
                 self.admission.count_admitted(txn_len(txn))
                 self._ingest_txn(doc, txn)
@@ -343,9 +409,16 @@ class ShardRouter:
     def _trace_codec_reject(self, doc_id: Optional[str],
                             err: CodecError) -> None:
         """One trace event + (bounded) post-mortem bundle per codec
-        rejection — the 'what came off the wire right before' record."""
+        rejection — the 'what came off the wire right before' record.
+        When the decoder could name the offending span (txn-level
+        validation failures), its ``(agent, seq)`` range rides the
+        event (ISSUE 11 satellite)."""
         if self.tracer is not None:
-            self.tracer.event("codec.reject", doc=doc_id, err=str(err))
+            span = {}
+            if err.agent is not None:
+                span = {"agent": err.agent, "seq": err.seq, "n": err.n}
+            self.tracer.event("codec.reject", doc=doc_id, err=str(err),
+                              **span)
         if self.recorder is not None:
             self.recorder.on_failure("codec", str(err), doc_id=doc_id)
 
@@ -363,22 +436,27 @@ class ShardRouter:
         if self.recorder is not None:
             self.recorder.note_frame(None, data)
         try:
-            kind, groups, _ = codec.decode_frame(data)
+            kind, groups, _, finfo = codec.decode_frame_ex(data)
         except CodecError as e:
             self._trace_codec_reject(None, e)
-            raise self.admission.reject_frame(str(e)) from None
+            raise self.admission.reject_frame(
+                str(e), agent=e.agent, seq=e.seq, n=e.n) from None
         if kind != codec.KIND_TXNS_MUX:
             raise self.admission.reject_frame(
                 f"frame kind {kind} on the mux lane")
         self.counters.incr("frames_received")
         rejected: List[Tuple[str, str]] = []
         for doc_id, txns in groups:
+            if self.flow is not None:
+                self.flow.framed(doc_id, txns, finfo.crc)
             try:
                 doc = self.doc(doc_id)
                 for i, txn in enumerate(txns):
                     self.admission.check(doc_id, txn.id.agent, txn_len(txn),
-                                         doc.pending() + i, self._tick)
+                                         doc.pending() + i, self._tick,
+                                         seq=txn.id.seq)
             except AdmissionError as e:
+                self._flow_reject_txns(doc_id, txns, e.reason)
                 rejected.append((doc_id, str(e)))
                 continue
             for txn in txns:

@@ -25,6 +25,7 @@ from typing import Dict, List, Optional
 from ..common import RemoteTxn
 from ..config import ServeConfig
 from ..models.sync import state_digest
+from ..obs.flow import FlowTracker
 from ..obs.recorder import FlightRecorder
 from ..obs.registry import MetricsRegistry
 from ..obs.trace import Tracer
@@ -53,6 +54,10 @@ class DocServer:
         self.tracer = Tracer(enabled=cfg.trace, ring=cfg.trace_ring,
                              keep_all=cfg.trace_keep, path=cfg.trace_path,
                              rotate_bytes=cfg.trace_rotate_bytes)
+        # Per-op provenance (ISSUE 11): one FlowTracker shared by every
+        # layer an op crosses, agent-sampled (flow_sample_mod).
+        self.flow = FlowTracker(self.tracer,
+                                sample_mod=cfg.flow_sample_mod)
         self.admission = AdmissionControl(
             max_queue_per_doc=cfg.max_queue_per_doc,
             max_queue_global=cfg.max_queue_global,
@@ -64,7 +69,7 @@ class DocServer:
         self.router = ShardRouter(cfg.num_shards, admission=self.admission,
                                   counters=self.counters,
                                   wire_format=cfg.wire_format,
-                                  tracer=self.tracer)
+                                  tracer=self.tracer, flow=self.flow)
         backends = [
             make_lane_backend(cfg.engine, lanes=cfg.lanes_per_shard,
                               capacity=cfg.lane_capacity,
@@ -98,7 +103,8 @@ class DocServer:
                                          fuse_steps=cfg.fuse_steps,
                                          fuse_w=cfg.fuse_w,
                                          tracer=self.tracer,
-                                         recorder=self.recorder)
+                                         recorder=self.recorder,
+                                         flow=self.flow)
         self.tick_no = 0
         self._profiling = False
 
@@ -217,6 +223,14 @@ class DocServer:
         if not doc.resident:
             return True
         return self.residency.verify_lane(doc)
+
+    def flow_summary(self, expect_terminal: bool = False) -> Dict[str, object]:
+        """Per-op provenance census + conservation audit over the
+        tracked (sampled) spans: terminal-state counts, findings, and
+        op-age-at-apply distributions in logical ticks.  With
+        ``expect_terminal`` every still-in-flight span is a named
+        finding — the end-of-run audit mode."""
+        return self.flow.report(expect_terminal=expect_terminal)
 
     def latency_summary(self) -> Dict[str, float]:
         """Admission->applied latency percentiles in microseconds."""
