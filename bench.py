@@ -1349,6 +1349,14 @@ def cfg_serve(args):
             "ages_ticks", {}).get("p50", 0),
         flow_age_p99_ticks=(report.get("flow") or {}).get(
             "ages_ticks", {}).get("p99", 0),
+        # ISSUE 12: pipelined-tick + Nagle-window ride-alongs (additive
+        # fields): how much device-sync demand the staged sync hid, and
+        # the emission window the run shipped under.
+        pipeline_ticks=(report.get("pipeline") or {}).get("ticks", 1),
+        pipeline_overlap_frac=(report.get("pipeline") or {}).get(
+            "overlap_frac", 0.0),
+        nagle_txns=col_wire.get("nagle_txns"),
+        nagle_rounds=col_wire.get("nagle_rounds"),
         wire_format=col_wire["format"],
         ckpt_format=report["ckpt"]["format"],
         wire_bytes_total=col_wire["txn_bytes"],
@@ -1428,6 +1436,11 @@ def cfg_serve_lanes(args):
             "ages_ticks", {}).get("p50", 0),
         flow_age_p99_ticks=(rep.get("flow") or {}).get(
             "ages_ticks", {}).get("p99", 0),
+        pipeline_ticks=(rep.get("pipeline") or {}).get("ticks", 1),
+        pipeline_overlap_frac=(rep.get("pipeline") or {}).get(
+            "overlap_frac", 0.0),
+        nagle_txns=(rep.get("wire") or {}).get("nagle_txns"),
+        nagle_rounds=(rep.get("wire") or {}).get("nagle_rounds"),
         p50_admission_to_applied_us=rep["latency_us"]["p50"],
         p99_admission_to_applied_us=rep["latency_us"]["p99"],
         evictions=rep["evictions"], restores=rep["restores"],

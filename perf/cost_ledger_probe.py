@@ -83,7 +83,13 @@ SMALL_LOADGEN = dict(docs=6, agents_per_doc=2, ticks=6,
 SERVE_SHAPE = dict(num_shards=1, lanes_per_shard=4)
 FUSED_TRACE = "automerge-paper"
 FUSED_PATCHES = 4000
-FUSED_LMAX = 8     # the ServeConfig default — serve-shaped streams
+from text_crdt_rust_tpu.config import ServeConfig as _ServeConfig  # noqa: E402
+
+FUSED_LMAX = _ServeConfig().lmax  # the ServeConfig default (16 since
+#                    the ISSUE-12 typing-lmax sweep) — ONE source of
+#                    truth with the HLO cell's backend, so a future
+#                    default change re-records both cells together
+#                    instead of drifting them apart
 FUSED_W = 8
 SP_PATCHES = 120
 SP_SHARD_ROWS = 64
@@ -138,11 +144,12 @@ def _hlo_flat_metrics(platform_note: str = "cpu") -> dict:
     from text_crdt_rust_tpu.serve.batcher import FlatLaneBackend
 
     backend = FlatLaneBackend(lanes=SERVE_SHAPE["lanes_per_shard"],
-                              capacity=512, order_capacity=1536, lmax=8)
+                              capacity=512, order_capacity=1536,
+                              lmax=FUSED_LMAX)
     out = {}
     for s_bkt in HLO_BUCKETS:
         stacked = B.stack_ops(
-            [B.pad_ops(B.empty_ops(8), s_bkt)
+            [B.pad_ops(B.empty_ops(FUSED_LMAX), s_bkt)
              for _ in range(backend.lanes)])
         lowered = F._apply_ops_batch.lower(backend.docs, stacked,
                                            local_only=False)

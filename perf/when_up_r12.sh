@@ -1,0 +1,80 @@
+#!/bin/bash
+# Round-12 recovery watcher (ISSUE 12 / ROADMAP #1): supersedes
+# when_up_r11.sh and keeps its gate chain — matmul tunnel probe ->
+# compile pin -> fused kevin device smoke -> serve device smokes ->
+# kevin full 5M -> the remaining rows via --merge-rows — then the COST
+# LEDGER device re-record.  New in r12: a PIPELINED serve device smoke
+# gates the row re-records (the flat backend's double-buffered tick on
+# real silicon: async dispatch + the staged sync must hold the
+# byte-identical logical contract where device steps actually take
+# wall time — this is where the overlap stops being a CPU formality),
+# and the re-recorded serve/serve-lanes rows carry the additive
+# pipeline_overlap_frac / nagle_txns fields.  Safe to re-run; appends
+# to perf/when_up_r12.log.
+set -u
+cd /root/repo
+while true; do
+  if timeout 240 python -c "
+import jax, numpy as np, jax.numpy as jnp
+x = jnp.ones((128,128), jnp.bfloat16)
+assert float(np.asarray(x @ x)[0,0]) == 128.0
+" >/dev/null 2>&1; then
+    echo "$(date -u +%H:%M:%S) tunnel is back (r12 watcher)" >> perf/when_up_r12.log
+    break
+  fi
+  echo "$(date -u +%H:%M:%S) still down (r12)" >> perf/when_up_r12.log
+  sleep 120
+done
+timeout 2400 python perf/compile_pin.py >> perf/compile_pin_r12.log 2>&1 \
+  || echo "PIN FAILED/TIMED OUT rc=$? - investigate before trusting bench" \
+       >> perf/compile_pin_r12.log
+# Fused-kernel device smoke first: a tiny fused kevin (2048 prepends,
+# W=8) proves the W-row splice compiles on real Mosaic before
+# committing to the 40-min full run.
+timeout 1800 python bench.py --config kevin --smoke --no-probe \
+  >> perf/when_up_r12.log 2>&1 \
+  || { echo "fused kevin device smoke FAILED rc=$? - NOT re-recording" \
+         >> perf/when_up_r12.log; exit 1; }
+# Pipelined serve device smoke (new in r12): the double-buffered tick
+# on the flat backend, on-device — the staged sync overlapping real
+# device steps, convergence + lane bit-identity still green.
+timeout 1800 python -m text_crdt_rust_tpu.serve.loadgen --device \
+  --docs 24 --ticks 10 --pipeline-ticks 2 \
+  >> perf/when_up_r12.log 2>&1 \
+  || { echo "pipelined serve device smoke FAILED rc=$? - NOT re-recording" \
+         >> perf/when_up_r12.log; exit 1; }
+# Fused serve-lanes loadgen smoke — the blocked mixed kernel's fused
+# splice + the serve stack's fused ticks on device (the lanes backend
+# clamps the pipeline to serial; that clamp is part of the smoke).
+timeout 1800 python -m text_crdt_rust_tpu.serve.loadgen --device \
+  --docs 24 --ticks 10 --engine rle-lanes-mixed \
+  >> perf/when_up_r12.log 2>&1 \
+  || { echo "fused serve-lanes device smoke FAILED rc=$? - NOT re-recording" \
+         >> perf/when_up_r12.log; exit 1; }
+# Headline: kevin at full 5M, fused W=64 (rle-hbm-fused row).
+timeout 7200 python bench.py --config kevin --merge-rows --no-probe \
+  >> perf/bench_kevin_r12.log 2>&1 \
+  || echo "kevin re-record FAILED rc=$?" >> perf/when_up_r12.log
+# Remaining rows, most verdict-critical first; every merged row is
+# ledger_version-stamped by the exporter (serve/serve-lanes rows carry
+# the additive flow_* provenance + pipeline_overlap_frac/nagle_txns
+# fields).
+for cfg in northstar 4 5r 5 serve serve-lanes sp; do
+  timeout 7200 python bench.py --config "$cfg" --merge-rows --no-probe \
+    >> "perf/bench_cfg${cfg}_r12.log" 2>&1 \
+    || echo "config $cfg re-record FAILED rc=$?" >> perf/when_up_r12.log
+done
+# The cost-ledger silicon cells: device-step wall histograms +
+# real-HLO costs + the flow-device per-op provenance cell, appended to
+# the committed ledger (cpu cells untouched).  On-chip logical op ages
+# must reproduce the re-recorded cpu flow cell (clean-remote p50 4 at
+# the small shape) EXACTLY.
+timeout 3600 python perf/cost_ledger_probe.py --device \
+  >> perf/when_up_r12.log 2>&1 \
+  || echo "ledger device re-record FAILED rc=$?" >> perf/when_up_r12.log
+# And prove the cpu contract still holds from this very checkout.
+timeout 1800 env JAX_PLATFORMS=cpu python bench.py --check-ledger \
+  >> perf/when_up_r12.log 2>&1 \
+  || echo "LEDGER CHECK FAILED rc=$? - cpu cost contract drifted" \
+       >> perf/when_up_r12.log
+echo "$(date -u +%H:%M:%S) r12 re-record done" >> perf/when_up_r12.log

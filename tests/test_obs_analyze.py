@@ -166,3 +166,67 @@ def test_cli_end_to_end(tmp_path):
     assert r.returncode == 0
     with open(out) as f:
         assert json.load(f)["traceEvents"]
+
+
+def test_stall_budget_names_top_phase():
+    """ISSUE 12 satellite: the one-number stall headline derives from
+    the breakdown (top phase by total wall + its share)."""
+    d = A.phase_breakdown(events())
+    b = A.stall_budget(d)
+    assert b["phase"] in A.PHASES
+    assert b["wall_ms"] == d["phases"][b["phase"]]["wall_ms"]
+    assert b["wall_ms"] == max(r["wall_ms"] for r in d["phases"].values())
+    assert b["share_pct"] == d["phases"][b["phase"]]["share_pct"]
+    empty = A.stall_budget(A.phase_breakdown([]))
+    assert empty["phase"] is None and empty["wall_ms"] == 0.0
+
+
+def test_overlap_report_accounting():
+    """ISSUE 12: host-vs-device occupancy from synthetic events with
+    known walls — stall, window, host and dispatch sums are exact, and
+    overlap_frac = win / (win + stall)."""
+    evs = [
+        {"i": 0, "t": 1, "k": "tick.drain", "shard": 0, "events": 3,
+         "steps": 5, WALL_KEY: {"ms": 4.0}},
+        {"i": 1, "t": 1, "k": "residency.evict", "doc": "d", "ckpt":
+         "delta", "bytes": 10, WALL_KEY: {"ms": 2.0}},
+        {"i": 2, "t": 1, "k": "tick.device", "shard": 0, "bucket": 8,
+         "lanes": 1, "steps": 5, WALL_KEY: {"ms": 1.0}},
+        {"i": 3, "t": 1, "k": "tick.barrier", "shard": 0,
+         WALL_KEY: {"ms": 3.0, "win": 9.0}},
+        {"i": 4, "t": 2, "k": "tick.drain", "shard": 0, "events": 1,
+         "steps": 1, WALL_KEY: {"ms": 6.0}},
+        {"i": 5, "t": 2, "k": "tick.barrier", "shard": 0,
+         WALL_KEY: {"ms": 1.0, "win": 7.0}},
+    ]
+    d = A.overlap_report(evs)
+    assert d["ticks"] == 2
+    assert d["host_ms"] == 12.0      # drain 4+6 + evict 2
+    assert d["dispatch_ms"] == 1.0
+    assert d["stall_ms"] == 4.0
+    assert d["win_ms"] == 16.0
+    assert d["overlap_frac"] == round(16.0 / 20.0, 4)
+    assert d["idle_gap_ms"]["max"] == 3.0
+    assert d["worst_ticks"][0]["tick"] == 1
+    # Serial traces (no "win" key) read frac 0 over pure stall.
+    serial = A.overlap_report([
+        {"i": 0, "t": 1, "k": "tick.barrier", "shard": 0,
+         WALL_KEY: {"ms": 5.0}}])
+    assert serial["overlap_frac"] == 0.0
+    assert serial["stall_share_pct"] == 100.0
+
+
+def test_overlap_cli_runs(tmp_path):
+    out = subprocess.run(
+        [sys.executable, "-m", "text_crdt_rust_tpu.obs.analyze",
+         "overlap", FIXTURE, "--json"],
+        capture_output=True, text=True)
+    assert out.returncode == 0
+    d = json.loads(out.stdout)
+    assert {"ticks", "overlap_frac", "idle_gap_ms"} <= set(d)
+    budget = subprocess.run(
+        [sys.executable, "-m", "text_crdt_rust_tpu.obs.analyze",
+         "phases", FIXTURE, "--stall-budget"],
+        capture_output=True, text=True)
+    assert budget.returncode == 0
+    assert "stall budget:" in budget.stdout

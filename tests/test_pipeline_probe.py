@@ -1,0 +1,80 @@
+"""Tier-1 smoke for ``perf/pipeline_probe.py`` (ISSUE 12 acceptance):
+the committed ``perf/pipeline_r14.json`` is the full 200-doc run; this
+keeps the small-scale path green (serial-vs-pipelined byte-identity,
+overlap accrued, audits green) so the JSON can't silently rot, and a
+``slow``-tier run re-measures the committed claims at full scale.
+
+Wall-based claims (the 5% regression bar) are asserted only against
+the committed artifact and in the ``slow`` re-run — smoke walls on a
+shared box are noise.
+"""
+import importlib.util
+import json
+import os
+
+import pytest
+
+PROBE = os.path.join("perf", "pipeline_probe.py")
+COMMITTED = os.path.join("perf", "pipeline_r14.json")
+
+
+def _load_probe():
+    spec = importlib.util.spec_from_file_location("pp", PROBE)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_probe_smoke_path_green():
+    out = _load_probe().run_matrix(smoke=True, reps=1)
+    p = out["pipeline"]
+    assert p["logical_streams_byte_identical"]
+    assert p["flow_reports_identical"]
+    assert p["serial"]["pipeline_ticks"] == 1
+    assert p["serial"]["overlap_frac"] == 0.0
+    assert p["pipelined"]["pipeline_ticks"] == 2
+    assert p["pipelined"]["overlap_frac"] > 0.0
+    assert out["defaults"]["audit_ok"]
+    # Every nagle arm converged with a green audit, and the sweep is
+    # monotone where it must be: the smallest window's clean-remote
+    # p50 is no worse than the biggest's.
+    arms = out["nagle_sweep"]
+    assert all(a["audit_ok"] for a in arms.values())
+    keys = list(arms)
+    assert arms[keys[-1]]["clean_p50"] <= arms[keys[0]]["clean_p50"]
+    # lmax sweep: larger chunks never need MORE device steps.
+    lx = out["lmax_sweep"]
+    assert lx["32"]["steps_total"] <= lx["16"]["steps_total"] \
+        <= lx["8"]["steps_total"]
+
+
+def test_committed_pipeline_json_claims():
+    """The committed probe JSON's acceptance: byte-identical modes,
+    overlap > 0 within the 5% wall bar, and the Nagle sweep's
+    clean-remote op-age cut (p50 <= 6 at the shipped default, from
+    ~12-13 at the old 64-txn window)."""
+    with open(COMMITTED) as f:
+        d = json.load(f)
+    assert not d["smoke"], "committed JSON must be the full 200-doc run"
+    assert d["workload"]["docs"] == 200
+    assert d["acceptance"]["pass"]
+    p = d["pipeline"]
+    assert p["logical_streams_byte_identical"]
+    assert p["flow_reports_identical"]
+    assert p["pipelined"]["overlap_frac"] > 0.0
+    assert p["wall_delta_pct"] <= d["acceptance"][
+        "wall_regression_bar_pct"]
+    assert d["acceptance"]["clean_p50_before"] >= 12
+    assert d["acceptance"]["clean_p50_shipped"] <= d["acceptance"][
+        "clean_p50_floor_ticks"]
+    # The shipped defaults row matches a swept arm's logical numbers.
+    key = f"{d['defaults']['nagle_txns']}/{d['defaults']['nagle_rounds']}"
+    assert key in d["nagle_sweep"]
+    assert d["nagle_sweep"][key]["clean_p50"] == d["defaults"][
+        "clean_p50"]
+
+
+@pytest.mark.slow
+def test_probe_full_rerun_matches_committed_claims():
+    out = _load_probe().run_matrix(smoke=False, reps=2)
+    assert out["acceptance"]["pass"], out

@@ -195,7 +195,36 @@ class ServeConfig:
     #                            loadgen tick trace)
     interpret: Optional[bool] = None  # pallas interpreter for the lanes
     #                            backend (None = auto: on unless on TPU)
-    lmax: int = 8              # insert-chunk width of compiled serve steps
+    lmax: int = 16             # insert-chunk width of compiled serve
+    #                            steps — ALSO the cap on fused typing
+    #                            rows (ISSUE 12 satellite, the PR-6
+    #                            lever: 8 capped merged typing runs at
+    #                            one word).  The pipeline_probe lmax
+    #                            sweep on --workload typing: 8 -> 2628
+    #                            device steps, 16 -> 2007 (-24%) at
+    #                            equal wall, 32 -> 1856 but +25% CPU
+    #                            wall from the 4x-wider char columns —
+    #                            16 is the shipped winner (PERF.md §17)
+    pipeline_ticks: int = 2    # host/device tick pipelining depth
+    #                            (ISSUE 12): 2 = double-buffered — tick
+    #                            N+1's drain/fuse/oracle-apply/compile
+    #                            (and residency checkpoint I/O) run on
+    #                            the host while tick N's device step is
+    #                            still in flight, the per-tick
+    #                            block_until_ready deferred to ONE
+    #                            staged sync point a tick later; 1 =
+    #                            the serial PR-3 loop (dispatch ->
+    #                            barrier every tick).  Logical streams,
+    #                            flow spans and ledger counters are
+    #                            byte-identical at any depth — only
+    #                            wall time moves (pinned by
+    #                            tests/test_serve_pipeline.py).
+    #                            Backends opt in via their
+    #                            ``max_pipeline_ticks`` (the blocked
+    #                            lanes backend trues up exact per-lane
+    #                            row counts at its barrier, so it
+    #                            stays serial until that true-up is
+    #                            pipeline-safe)
     step_buckets: tuple = (8, 32, 128)  # padded tick step shapes; a tick
     #                            drains at most step_buckets[-1] compiled
     #                            steps per doc so steady-state serving
@@ -218,6 +247,21 @@ class ServeConfig:
     #                            min(fuse_w, lanes_block_k // 2 - 1) on
     #                            backends with the W-row splice, 1 on
     #                            the rest (the one-split headroom rule)
+    nagle_txns: int = 16       # columnar-wire emission Nagle window
+    #                            (ISSUE 12, the §16 latency lever): a
+    #                            peer outbox ships once it holds this
+    #                            many txns...
+    nagle_rounds: int = 4      # ...or has waited this many ticks
+    #                            regardless.  The loadgen's flush
+    #                            policy reads both (--nagle-txns /
+    #                            --nagle-rounds); smaller windows cut
+    #                            clean-remote op-age (emission-to-frame
+    #                            batching dominates it, PERF.md §16) at
+    #                            a bytes/op cost — 16/4 is the
+    #                            perf/pipeline_probe.py sweep winner
+    #                            (clean-remote p50 13 -> 4 ticks for
+    #                            +14% bytes/op at the 200-doc faulted
+    #                            shape, PERF.md §17)
     wire_format: str = "columnar"  # TXNS frames the server EMITS
     #                            (request serving): "row" = PR-1 frame
     #                            version 1, "columnar" = the version-2

@@ -6,7 +6,13 @@ answers the questions a regression hunt or a post-mortem actually asks:
 
 - ``phases``     — per-tick phase critical-path breakdown (drain /
   fuse / capacity / device / barrier): where a tick's wall time went,
-  aggregated and for the slowest ticks;
+  aggregated and for the slowest ticks; ``--stall-budget`` appends the
+  one-number headline (top phase by total wall, % of tick) the
+  pipelined-tick work gates on;
+- ``overlap``    — host-vs-device occupancy (ISSUE 12): per tick, host
+  wall (drain/fuse/capacity + residency checkpoint I/O) vs dispatch
+  wall vs the barrier's idle gap, and the pipelined batcher's
+  ``overlap_frac`` (device-sync demand hidden under host work);
 - ``hotdocs``    — apply-event volume by doc (who is hot);
 - ``fuse``       — fusion efficiency by doc (steps in vs out);
 - ``recompiles`` — the ``device.compile`` timeline (steady state must
@@ -140,6 +146,79 @@ def phase_breakdown(events: Sequence[dict], slowest: int = 5) -> dict:
         },
         "slowest_ticks": sorted(tick_rows, key=lambda r: -r["total_ms"]
                                 )[:slowest],
+    }
+
+
+def stall_budget(breakdown: dict) -> dict:
+    """The one-number headline of a phase breakdown: the phase that
+    owns the most measured wall and its share of the total — what the
+    pipelined-tick refactor (ISSUE 12) must shrink, read before/after
+    from any trace."""
+    phases = breakdown["phases"]
+    top = max(phases, key=lambda p: phases[p]["wall_ms"]) if phases \
+        else None
+    if top is None or not breakdown["wall_ms_total"]:
+        return {"phase": None, "wall_ms": 0.0, "share_pct": 0.0}
+    return {"phase": top, "wall_ms": phases[top]["wall_ms"],
+            "share_pct": phases[top]["share_pct"]}
+
+
+#: Host-phase walls the overlap report counts as work the pipelined
+#: tick can hide an in-flight device step under; ``tick.device`` is the
+#: dispatch (enqueue) wall, ``tick.barrier`` the residual sync stall.
+OVERLAP_HOST_KINDS = ("tick.drain", "tick.fuse", "tick.capacity",
+                      "residency.evict", "residency.restore")
+
+
+def overlap_report(events: Sequence[dict], slowest: int = 5) -> dict:
+    """Host-vs-device occupancy of the serving loop (ISSUE 12): per
+    tick, measured host wall (drain/fuse/capacity + residency
+    checkpoint I/O), dispatch wall, and the barrier's idle gap — the
+    stall the staged sync still paid ("ms") vs the host window the
+    in-flight device step got to hide under ("win", stamped by the
+    pipelined batcher).  ``overlap_frac`` = win / (win + stall): 0 in
+    the serial loop, -> 1 when host work fully hides device time."""
+    per_tick: Dict[int, Dict[str, float]] = {}
+
+    def row(t: int) -> Dict[str, float]:
+        return per_tick.setdefault(int(t), {
+            "host_ms": 0.0, "dispatch_ms": 0.0, "stall_ms": 0.0,
+            "win_ms": 0.0})
+
+    for ev in events:
+        k = ev.get("k")
+        if k in OVERLAP_HOST_KINDS:
+            row(ev["t"])["host_ms"] += _wall_ms(ev)
+        elif k == "tick.device":
+            row(ev["t"])["dispatch_ms"] += _wall_ms(ev)
+        elif k == "tick.barrier":
+            r = row(ev["t"])
+            r["stall_ms"] += _wall_ms(ev)
+            w = ev.get(WALL_KEY)
+            if isinstance(w, dict):
+                r["win_ms"] += float(w.get("win", 0.0))
+    from ..utils.metrics import percentiles
+
+    tot = {key: round(sum(r[key] for r in per_tick.values()), 3)
+           for key in ("host_ms", "dispatch_ms", "stall_ms", "win_ms")}
+    busy = tot["host_ms"] + tot["dispatch_ms"] + tot["stall_ms"]
+    hidden = tot["win_ms"] + tot["stall_ms"]
+    stalls = [r["stall_ms"] for r in per_tick.values()]
+    gap = {k: round(v, 3)
+           for k, v in percentiles(stalls, (50, 99)).items()}
+    gap["max"] = round(max(stalls), 3) if stalls else 0.0
+    tick_rows = [{"tick": t, **{k: round(v, 3) for k, v in r.items()}}
+                 for t, r in sorted(per_tick.items())]
+    return {
+        "ticks": len(per_tick),
+        **tot,
+        "overlap_frac": round(tot["win_ms"] / hidden, 4) if hidden
+        else 0.0,
+        "stall_share_pct": round(tot["stall_ms"] / busy * 100.0, 1)
+        if busy else 0.0,
+        "idle_gap_ms": gap,
+        "worst_ticks": sorted(tick_rows,
+                              key=lambda r: -r["stall_ms"])[:slowest],
     }
 
 
@@ -338,12 +417,17 @@ def main(argv=None) -> int:
         description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
     sub = ap.add_subparsers(dest="cmd", required=True)
-    for name in ("phases", "hotdocs", "fuse", "recompiles"):
+    for name in ("phases", "hotdocs", "fuse", "recompiles", "overlap"):
         p = sub.add_parser(name)
         p.add_argument("trace", nargs="+",
                        help="trace JSONL segment(s) or bundle JSON")
         p.add_argument("--json", action="store_true")
         p.add_argument("--top", type=int, default=10)
+        if name == "phases":
+            p.add_argument("--stall-budget", action="store_true",
+                           help="append the one-line stall budget: the "
+                                "phase owning the most wall and its "
+                                "share of the measured total")
     p = sub.add_parser("diff")
     p.add_argument("a")
     p.add_argument("b")
@@ -391,8 +475,34 @@ def main(argv=None) -> int:
 
     if args.cmd == "phases":
         d = phase_breakdown(events)
-        print(json.dumps(d, indent=1, sort_keys=True)) if args.json \
-            else _print_phases(d)
+        if args.stall_budget:
+            d = {**d, "stall_budget": stall_budget(d)}
+        if args.json:
+            print(json.dumps(d, indent=1, sort_keys=True))
+        else:
+            _print_phases(d)
+            if args.stall_budget:
+                b = d["stall_budget"]
+                print(f"stall budget: {b['phase']} owns "
+                      f"{b['wall_ms']:.3f} ms = {b['share_pct']:.1f}% "
+                      f"of measured tick wall")
+    elif args.cmd == "overlap":
+        d = overlap_report(events, slowest=args.top)
+        if args.json:
+            print(json.dumps(d, indent=1, sort_keys=True))
+        else:
+            print(f"{d['ticks']} ticks: host {d['host_ms']:.1f} ms, "
+                  f"dispatch {d['dispatch_ms']:.1f} ms, sync stall "
+                  f"{d['stall_ms']:.1f} ms ({d['stall_share_pct']}% of "
+                  f"busy wall), overlap window {d['win_ms']:.1f} ms")
+            print(f"overlap_frac {d['overlap_frac']} (device-sync "
+                  f"demand hidden under host work); idle gap per tick: "
+                  f"p50 {d['idle_gap_ms']['p50']} p99 "
+                  f"{d['idle_gap_ms']['p99']} max "
+                  f"{d['idle_gap_ms']['max']} ms")
+            _print_table(d["worst_ticks"],
+                         ["tick", "host_ms", "dispatch_ms", "stall_ms",
+                          "win_ms"])
     elif args.cmd == "hotdocs":
         d = hot_docs(events, top=args.top)
         if args.json:

@@ -69,6 +69,14 @@ class FlatLaneBackend:
     # batcher's tick fusion still coalesces shapes into plain W=1 rows
     # for it, but never emits multi-row burst steps.
     max_fuse_w = 1
+    # Pipeline-safe (ISSUE 12): every host-side probe (``fits`` /
+    # ``fits_doc`` / ``tick_fits``) reads the HOST oracle, never device
+    # state, and ``barrier`` performs no true-up — so a tick's device
+    # pass may stay in flight through the whole next host tick, synced
+    # only at the staged sync point via ``sync_token``.  Backends whose
+    # barrier trues up probe state (the blocked lanes backend's exact
+    # per-lane row counts) leave this at the default 1 and stay serial.
+    max_pipeline_ticks = 8
 
     def __init__(self, lanes: int, capacity: int, order_capacity: int,
                  lmax: int, block_k: Optional[int] = None,
@@ -146,6 +154,13 @@ class FlatLaneBackend:
 
     def barrier(self) -> None:
         np.asarray(self.docs.n)
+
+    def sync_token(self):
+        """The device-completion handle for everything enqueued so far:
+        blocking on THIS array waits for work through this tick without
+        serializing against anything dispatched after the capture (the
+        staged-sync contract of ``max_pipeline_ticks`` > 1)."""
+        return self.docs.n
 
     def lane_doc(self, b: int):
         return jax.tree.map(lambda x: x[b], self.docs)
@@ -250,7 +265,8 @@ class ContinuousBatcher:
                  step_buckets: Tuple[int, ...], lmax: int,
                  counters: Optional[Counters] = None,
                  fuse_steps: bool = False, fuse_w: int = 1,
-                 tracer=None, recorder=None, flow=None):
+                 tracer=None, recorder=None, flow=None,
+                 pipeline_ticks: int = 1):
         assert tuple(sorted(step_buckets)) == tuple(step_buckets)
         self.router = router
         self.residency = residency
@@ -273,6 +289,43 @@ class ContinuousBatcher:
         self.fuse_stats = B.FuseStats()
         self.latency_samples: List[float] = []
         self.tick_wall_samples: List[float] = []  # per-tick wall seconds
+        # Pipelined tick (ISSUE 12): with depth D, up to D-1 ticks'
+        # device passes stay in flight while the host stages the next
+        # tick (jax async dispatch returns before completion — the
+        # per-tick barrier was OURS, not XLA's).  Each tick appends one
+        # entry carrying the per-shard sync tokens and the tick's
+        # applied events; the staged sync pops entries past the depth
+        # at the barrier slot — the SAME logical stream position in
+        # every mode, so pipelining moves only wall time (the
+        # cross-mode byte-identity contract of
+        # tests/test_serve_pipeline.py).  The effective depth is capped
+        # by the backends' ``max_pipeline_ticks`` (1 = a barrier-time
+        # true-up makes deferral unsafe — the blocked lanes backend).
+        self.pipeline_ticks = max(1, pipeline_ticks)
+        self._inflight: List[dict] = []
+        # Per-shard stall/win not yet attributed to a trace event: a
+        # deferred entry's sync may pay stall for a shard that has no
+        # device work — hence no tick.barrier event — THIS tick; the
+        # wall carries to that shard's next emitted barrier event so
+        # the trace totals match the in-memory accounting (end-of-run
+        # flush leftovers stay in-memory only).
+        self._pending_stall: Dict[int, float] = {}
+        self._pending_win: Dict[int, float] = {}
+        # End of the last staged sync: a later entry's overlap window
+        # opens at max(its dispatch, this) — time spent BLOCKING on an
+        # older entry is not window the host earned for the next one
+        # (same-shard device work is queued behind the older tick's
+        # anyway), and without the clamp that stall would double-count
+        # as both stall and win, flooring overlap_frac near 0.5 on a
+        # fully device-bound run.
+        self._last_sync_end = 0.0
+        # Overlap accounting: window = host wall an in-flight device
+        # step had to hide under (dispatch -> staged sync start), stall
+        # = what blocking still cost at the sync.  overlap_frac =
+        # window / (window + stall) — 0 in the serial loop, -> 1 as
+        # the pipeline fully hides device time.
+        self.overlap_window_s = 0.0
+        self.sync_stall_s = 0.0
         # Optional per-doc compiled-stream tap: called as
         # (doc_id, OpTensors) for every lane doc's tick stream BEFORE
         # padding/stacking — how perf/blocked_lanes_sim.py replays the
@@ -286,6 +339,96 @@ class ContinuousBatcher:
         raise AssertionError(
             f"tick stream of {steps} steps exceeds the largest bucket "
             f"{self.step_buckets[-1]} (drain budget bug)")
+
+    # -- pipelined staged sync ----------------------------------------------
+
+    def effective_pipeline_ticks(self) -> int:
+        """Configured depth capped by every backend's opt-in: one
+        backend that trues up probe state at its barrier serializes the
+        whole server (backends are homogeneous per server, so in
+        practice this is all-or-nothing)."""
+        return min([self.pipeline_ticks]
+                   + [getattr(b, "max_pipeline_ticks", 1)
+                      for b in self.residency.backends])
+
+    def _sync_entry(self, entry: dict) -> None:
+        """Block until one in-flight tick's device work is done: the
+        per-shard sync tokens when the entry was deferred (pipelined —
+        a token blocks through ITS tick's work without serializing
+        against later dispatches), ``backend.barrier()`` otherwise.
+        Stamps the entry's applied events' admission->applied latency
+        (device completion included, exactly as the serial loop's
+        post-barrier stamp did); per-token window/stall accounting
+        lives in ``_block_token``."""
+        for tok in entry["tokens"]:
+            self._block_token(entry, tok)
+        now = time.perf_counter()
+        for event in entry["events"]:
+            self.latency_samples.append(now - event.t_submit)
+
+    def _block_token(self, entry: dict, tok: dict) -> None:
+        """Block one shard's device work for one in-flight entry and
+        account it.  Window = host wall since the entry's dispatch (or
+        since the last block — time already spent BLOCKING is not
+        overlap the host earned: same-shard device work queues behind
+        what we were waiting for, and without the clamp a device-bound
+        pipelined run would floor near frac 0.5).  Only DEFERRED
+        entries (real sync tokens) accrue window: the serial loop's
+        immediate sync accrues stall only, so its µs-scale bookkeeping
+        gaps can't manufacture overlap and the documented contract —
+        frac == 0.0 at depth 1 — holds."""
+        if tok["done"]:
+            return
+        shard = tok["shard"]
+        t0 = time.perf_counter()
+        win = 0.0
+        if tok["token"] is not None:
+            win = max(0.0, t0 - max(entry["t_dispatched"],
+                                    self._last_sync_end))
+            np.asarray(tok["token"])
+        else:
+            self.residency.backends[shard].barrier()
+        stall = time.perf_counter() - t0
+        tok["done"] = True
+        self._last_sync_end = time.perf_counter()
+        self.overlap_window_s += win
+        self.sync_stall_s += stall
+        self._pending_stall[shard] = (
+            self._pending_stall.get(shard, 0.0) + stall)
+        self._pending_win[shard] = (
+            self._pending_win.get(shard, 0.0) + win)
+
+    def _sync_shard_inflight(self, shard: int) -> None:
+        """Complete SHARD's older in-flight device work right before a
+        new dispatch to it.  The flat backend's dispatch path reads
+        device state host-side (``_check_capacity``/``prefill_logs``),
+        which would otherwise block on the previous tick's work INSIDE
+        the dispatch-wall measurement — hiding any device time the
+        host window failed to cover from the stall accounting (a
+        metric blind spot, not a correctness issue: the read blocks
+        either way).  Syncing here keeps the dispatch wall
+        enqueue-only and charges un-hidden device time to the pipeline
+        stall it actually is — on any platform, TPU included."""
+        for entry in self._inflight:
+            for tok in entry["tokens"]:
+                if tok["shard"] == shard:
+                    self._block_token(entry, tok)
+
+    def flush_pipeline(self) -> None:
+        """Drain every in-flight tick (end of run / before reading
+        latency percentiles).  Emits no trace events, so a flushed
+        pipelined stream stays byte-identical to the serial one;
+        idempotent and a no-op in the serial loop (depth 1 never leaves
+        an entry behind)."""
+        while self._inflight:
+            self._sync_entry(self._inflight.pop(0))
+
+    def pipeline_overlap_frac(self) -> float:
+        """Fraction of the measured device-sync demand the pipeline hid
+        under host work: window / (window + stall).  0.0 in the serial
+        loop (no window), -> 1.0 when the staged sync never blocks."""
+        denom = self.overlap_window_s + self.sync_stall_s
+        return self.overlap_window_s / denom if denom > 0 else 0.0
 
     # -- per-event processing ----------------------------------------------
 
@@ -507,6 +650,7 @@ class ContinuousBatcher:
         applied_events: List[Event] = []
         active_shards: set = set()
         for shard, backend in enumerate(self.residency.backends):
+            t_drain = time.perf_counter()
             lane_streams: Dict[int, B.OpTensors] = {}
             host_only_applies = 0
             shard_events = 0
@@ -601,8 +745,14 @@ class ContinuousBatcher:
                                        scheduled)
 
             if tr is not None and (shard_events or shard_steps):
+                # Drain wall = the whole host-side doc loop (oracle
+                # apply + compile + fuse + capacity probes) — the phase
+                # the pipelined tick overlaps with the previous tick's
+                # in-flight device step (analyze.py overlap reads it).
                 tr.event("tick.drain", shard=shard, events=shard_events,
-                         steps=shard_steps)
+                         steps=shard_steps,
+                         wall={"ms": round((time.perf_counter()
+                                            - t_drain) * 1e3, 3)})
             if tr is not None and probed:
                 tr.event("tick.capacity", shard=shard, probed=probed,
                          degraded=degraded)
@@ -621,6 +771,13 @@ class ContinuousBatcher:
                     for b in range(backend.lanes)
                 ]
                 stacked = B.stack_ops(per_lane)
+                # Finish this shard's older in-flight work FIRST (the
+                # staged sync, pulled forward to the dispatch edge):
+                # apply()'s host-side device reads would block on it
+                # anyway, but inside the dispatch-wall window — this
+                # keeps disp_ms enqueue-only and charges un-hidden
+                # device time to the pipeline stall accounting.
+                self._sync_shard_inflight(shard)
                 t_dev = time.perf_counter()
                 backend.apply(stacked)
                 disp_ms = (time.perf_counter() - t_dev) * 1e3
@@ -647,18 +804,43 @@ class ContinuousBatcher:
                 self.counters.incr("device_steps", s_bkt)
             self.counters.incr("host_only_applies", host_only_applies)
 
-        # 3. Barrier, then stamp admission->applied latency and sync
-        #    causal watermarks with the oracles' out-of-band progress
-        #    (local edits), releasing dependents for the next tick.
+        # 3. The barrier slot.  The per-shard ``tick.barrier`` events
+        #    are emitted at the SAME logical stream position in every
+        #    mode (the pipelined-vs-serial byte-identity contract), but
+        #    the actual block_until_ready is staged behind the pipeline
+        #    depth: with depth D this tick's device pass stays in
+        #    flight while the next D-1 host ticks (drain, compile,
+        #    oracle applies, residency checkpoint I/O) run, and the
+        #    stall paid here is only the device time that host work
+        #    could not hide.  Admission->applied latency stamps ride
+        #    the staged sync, so they still include device completion.
+        depth = self.effective_pipeline_ticks()
+        tokens = []
         for shard, backend in enumerate(self.residency.backends):
-            if tr is not None and shard in active_shards:
-                with tr.span("tick.barrier", shard=shard):
-                    backend.barrier()
-            else:
-                backend.barrier()
+            tok = backend.sync_token() if depth > 1 else None
+            tokens.append({"shard": shard, "token": tok, "done": False})
+        self._inflight.append({"tick": tick_no, "tokens": tokens,
+                               "t_dispatched": time.perf_counter(),
+                               "events": applied_events})
+        while len(self._inflight) > depth - 1:
+            self._sync_entry(self._inflight.pop(0))
+        if tr is not None:
+            for shard in sorted(active_shards):
+                # Wall names the shard's accumulated unreported sync
+                # cost: the residual stall ("ms") and the host window
+                # the in-flight step got to hide under ("win").  Once
+                # the pipeline is primed the sync paid here belongs to
+                # the PREVIOUS tick's entry — and a shard with no
+                # device work this tick gets no event, so its numbers
+                # carry to its next emitted barrier (the trace totals
+                # stay equal to the in-memory accounting).  Logical
+                # content is mode-invariant; only wall numbers move.
+                tr.event("tick.barrier", shard=shard, wall={
+                    "ms": round(
+                        self._pending_stall.pop(shard, 0.0) * 1e3, 3),
+                    "win": round(
+                        self._pending_win.pop(shard, 0.0) * 1e3, 3)})
         now = time.perf_counter()
-        for event in applied_events:
-            self.latency_samples.append(now - event.t_submit)
         for doc in self.router.docs.values():
             if doc.resident:
                 released = doc.buffer.advance_watermarks(

@@ -44,17 +44,27 @@ TXNS_PER_FRAME = 4
 # loss unit on the fault channel — a dropped whole-window frame turns
 # into a multi-doc backfill pull.
 MUX_TXNS_PER_FRAME = 1024
-# Nagle-style push policy (columnar wire): a doc's outbox ships once it
-# holds this many txns, or after this many flush rounds regardless.
-FLUSH_MIN_TXNS = 64
-FLUSH_MAX_AGE = 6
+# The Nagle-style push policy (columnar wire) lives in ServeConfig
+# (``nagle_txns`` / ``nagle_rounds``, CLI ``--nagle-txns`` /
+# ``--nagle-rounds``): a doc's outbox ships once it holds nagle_txns
+# txns, or after nagle_rounds TICKS regardless (the flush check runs
+# every tick — emission-to-frame batching dominates clean-remote op
+# age, PERF.md §16, so the window is the serve loop's first-order
+# latency lever; perf/pipeline_probe.py sweeps it).
 # Pull chunking: a REQUEST want carries only a from-seq (the v1 control
 # frame), so the owed range is the WHOLE history suffix even when the
 # hole is one dropped frame. A faulty-phase pull ships a bounded chunk
 # per round — the causal buffer's watermark walks forward and the next
 # want narrows — instead of re-shipping the suffix every window. The
-# clean final drain ships unchunked, so recovery is never starved.
+# clean final drain chunks too, at the admission queue's scale: an
+# UNCHUNKED pull of a hot doc's long-stalled suffix (> max_queue_per_doc
+# txns) is rejected queue-full as one all-or-nothing group — and
+# re-offered identically every round, a zero-progress livelock the
+# ISSUE-12 Nagle sweep exposed at mid-size windows.  A bounded clean
+# chunk is always admissible once the inter-round tick drains the
+# queue, so the watermark advances every round and the want narrows.
 PULL_CHUNK_TXNS = 48
+PULL_CHUNK_TXNS_CLEAN = 128
 
 # The typing workload's deterministic vocabulary (real-text shape so
 # DEFLATE sees real-text statistics, not a uniform-random alphabet).
@@ -91,9 +101,10 @@ class _DocWorld:
         self.server_mark = 0
         # Columnar wire: fresh txns accumulate here between windowed
         # flushes instead of shipping per event.  ``outbox_age`` counts
-        # flush rounds the outbox has waited (the Nagle-style policy:
-        # ship when big enough OR old enough — tiny per-doc batches are
-        # where column chains and DEFLATE can't win).
+        # TICKS the outbox has waited (the Nagle-style policy: ship
+        # when big enough OR old enough — tiny per-doc batches are
+        # where column chains and DEFLATE can't win; the window knobs
+        # live in ServeConfig.nagle_txns/nagle_rounds).
         self.outbox: List[RemoteTxn] = []
         self.outbox_age = 0
         # Typing workload: per-agent cursor into the agent's replica.
@@ -256,16 +267,18 @@ class ServeLoadGen:
         the delta chains predict well).
 
         Nagle-style policy per doc: flush when the outbox reached
-        ``FLUSH_MIN_TXNS`` or waited ``FLUSH_MAX_AGE`` rounds (column
+        ``cfg.nagle_txns`` or waited ``cfg.nagle_rounds`` ticks (column
         chains and frame DEFLATE only pay on batches; the anti-entropy
-        pull covers anything a deferral or a dropped frame delays)."""
+        pull covers anything a deferral or a dropped frame delays).
+        The check runs EVERY tick — the window itself, not the resync
+        cadence, decides when a batch ships."""
         batches: List[Tuple[str, List[RemoteTxn]]] = []
         for world in self.worlds:
             if not world.outbox:
                 continue
             world.outbox_age += 1
-            if not (final or len(world.outbox) >= FLUSH_MIN_TXNS
-                    or world.outbox_age >= FLUSH_MAX_AGE):
+            if not (final or len(world.outbox) >= self.cfg.nagle_txns
+                    or world.outbox_age >= self.cfg.nagle_rounds):
                 continue
             batches.append((world.doc_id,
                             sorted(world.outbox,
@@ -348,8 +361,8 @@ class ServeLoadGen:
                 deferred = {(t.id.agent, t.id.seq) for t in world.outbox}
                 owed = [t for t in owed
                         if (t.id.agent, t.id.seq) not in deferred]
-                if faulty:
-                    owed = owed[:PULL_CHUNK_TXNS]
+                owed = owed[:PULL_CHUNK_TXNS if faulty
+                            else PULL_CHUNK_TXNS_CLEAN]
             if self.wire == "columnar":
                 # The pull lane is a backfill: ship ALL docs' owed
                 # ranges as one multiplexed columnar stream — per-doc
@@ -428,9 +441,15 @@ class ServeLoadGen:
                     world.outbox.extend(fresh)
                 else:
                     self._ship(world, agent, txns, faulty=True)
+        if self.wire == "columnar":
+            # The Nagle window is checked every tick (ISSUE 12): the
+            # flush cadence is the window's own, decoupled from the
+            # resync/anti-entropy cadence below — at the old
+            # once-per-resync-window cadence the effective emission
+            # latency floor was resync_every ticks no matter how small
+            # the window was set.
+            self._flush_mux(faulty=True)
         if (tick_index + 1) % self.resync_every == 0:
-            if self.wire == "columnar":
-                self._flush_mux(faulty=True)
             self._gossip_digests(faulty=True)
             self._resync(faulty=True)
         # Server-authored history reaches the twins in the final
@@ -455,6 +474,12 @@ class ServeLoadGen:
                 print(f"tick {i + 1}/{self.ticks}: applied {applied} "
                       f"item-ops, {rc['docs_in_lane']} in-lane / "
                       f"{rc['docs_evicted']} evicted", flush=True)
+        # The timed loop is not done until its device work is: flush
+        # the pipeline BEFORE the wall capture, so serial and pipelined
+        # arms account identical work (a depth-D run would otherwise
+        # push its last D-1 ticks' sync cost outside the loop wall and
+        # bias the probe's regression gate in its own favor).
+        self.server.flush_pipeline()
         loop_wall = time.perf_counter() - t0
 
         # Final drain: clean digests + re-delivery until the server owes
@@ -476,6 +501,7 @@ class ServeLoadGen:
         converged, mismatches = self.verify()
         wall = time.perf_counter() - t0
         stats = self.server.stats()
+        tick_sum = self.server.tick_summary()
         report = {
             "converged": converged,
             "mismatches": mismatches[:8],
@@ -487,11 +513,23 @@ class ServeLoadGen:
             "wall_s": round(wall, 3),
             "rejected_submissions": self.rejections,
             "latency_us": self.server.latency_summary(),
-            "tick_ms": self.server.tick_summary(),
+            "tick_ms": tick_sum,
             "engine": self.cfg.engine,
+            # Pipelined tick (ISSUE 12): effective depth, how much of
+            # the device-sync demand the staged sync hid under host
+            # work, and the residual stall.
+            "pipeline": {
+                "ticks": tick_sum.get("pipeline_ticks", 1),
+                "overlap_frac": tick_sum.get("pipeline_overlap_frac",
+                                             0.0),
+                "stall_ms_total": tick_sum.get("pipeline_stall_ms_total",
+                                               0.0),
+            },
             "wire": {
                 "format": self.wire,
                 "workload": self.workload,
+                "nagle_txns": self.cfg.nagle_txns,
+                "nagle_rounds": self.cfg.nagle_rounds,
                 "txn_bytes": self.wire_txn_bytes,
                 "push_bytes": self.wire_push_bytes,
                 "pull_bytes": self.wire_pull_bytes,
@@ -628,6 +666,23 @@ def main(argv=None) -> None:
                     choices=("scatter", "typing"),
                     help="agent edit shape: uniform-random positions "
                          "or cursor-based typing runs")
+    ap.add_argument("--pipeline-ticks", type=int, default=d.pipeline_ticks,
+                    help="host/device tick pipelining depth: 2 = "
+                         "double-buffered (stage the next tick's host "
+                         "work while the device step is in flight), "
+                         "1 = the serial loop; logical streams are "
+                         "byte-identical at any depth")
+    ap.add_argument("--nagle-txns", type=int, default=d.nagle_txns,
+                    help="columnar-wire Nagle window: flush a doc's "
+                         "outbox once it holds this many txns")
+    ap.add_argument("--nagle-rounds", type=int, default=d.nagle_rounds,
+                    help="...or once it has waited this many ticks "
+                         "(smaller = lower op age, more frame "
+                         "overhead; see perf/pipeline_probe.py sweep)")
+    ap.add_argument("--lmax", type=int, default=d.lmax,
+                    help="insert-chunk width of compiled serve steps "
+                         "(the typing-workload fusion lever: larger "
+                         "lmax folds longer typing runs per step)")
     ap.add_argument("--no-trace", action="store_true",
                     help="disable the obs/ event tracer (the overhead "
                          "probe's baseline arm)")
@@ -655,6 +710,9 @@ def main(argv=None) -> None:
     cfg = ServeConfig(engine=a.engine, num_shards=a.shards,
                       lanes_per_shard=a.lanes,
                       wire_format=a.wire, ckpt_format=a.ckpt,
+                      pipeline_ticks=a.pipeline_ticks,
+                      nagle_txns=a.nagle_txns,
+                      nagle_rounds=a.nagle_rounds, lmax=a.lmax,
                       trace=not a.no_trace, trace_path=a.trace_path,
                       trace_rotate_bytes=a.trace_rotate_bytes,
                       flow_sample_mod=a.flow_sample_mod,

@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import os
 import tempfile
+import time
 from typing import Dict, List, Optional
 
 from ..ops import batch as B
@@ -185,6 +186,7 @@ class LaneResidency:
         # emission must keep seeing the persisted history's extent
         # (router.poll_request_frame reads known_marks).
         doc.absorb_oracle_marks()
+        t_io = time.perf_counter()
         if self.ckpt_format == "delta":
             chain = self._chains.get(doc.doc_id)
             if chain is None:
@@ -197,6 +199,7 @@ class LaneResidency:
         else:
             info = checkpoint.save_doc(doc.oracle, path)
             info = {"kind": "full", "bytes": info["bytes"]}
+        io_ms = (time.perf_counter() - t_io) * 1e3
         self.counters.incr(f"ckpt_saves_{info['kind']}")
         if info["kind"] != "noop":
             # "noop" = the chain tip already covers this state (zero
@@ -224,9 +227,15 @@ class LaneResidency:
         self.release_lane(doc)
         self.counters.incr("evictions")
         if self.tracer is not None:
+            # The checkpoint-write wall rides the event (segregated
+            # under "w"): residency evictions run in the tick's host
+            # phase, so with the pipelined tick this I/O overlaps the
+            # previous tick's in-flight device step — analyze.py
+            # overlap counts it as hidden host work.
             self.tracer.event("residency.evict", doc=doc.doc_id,
                               ckpt=info["kind"], bytes=info.get("bytes", 0),
-                              n=n_items, orders=n_orders)
+                              n=n_items, orders=n_orders,
+                              wall={"ms": round(io_ms, 3)})
         return path
 
     def restore(self, doc: DocState, tick_no: Optional[int] = None) -> None:
@@ -237,6 +246,7 @@ class LaneResidency:
         never-evicted. ``tick_no`` stamps the touch so the same tick's
         LRU pass cannot immediately re-evict the doc it just restored."""
         assert doc.evicted and doc.ckpt_path
+        t_io = time.perf_counter()
         try:
             if self.ckpt_format == "delta":
                 oracle = self._chains[doc.doc_id].load()
@@ -264,10 +274,20 @@ class LaneResidency:
         if self.tracer is not None:
             # The restore side of the conservation pair: queued events
             # replay AFTER this through normal ticks, so these counts
-            # must equal the eviction snapshot's exactly.
+            # must equal the eviction snapshot's exactly.  The I/O wall
+            # rides the event only for IN-LOOP restores (tick_no set):
+            # end-of-run verification restores happen outside any tick,
+            # and counting their wall would inflate the overlap
+            # report's final-tick host occupancy with work no pipeline
+            # could ever hide.
+            wall = None
+            if tick_no is not None:
+                wall = {"ms": round(
+                    (time.perf_counter() - t_io) * 1e3, 3)}
             self.tracer.event("residency.restore", doc=doc.doc_id,
                               n=oracle.n,
-                              orders=oracle.get_next_order())
+                              orders=oracle.get_next_order(),
+                              wall=wall)
 
     # -- verification --------------------------------------------------------
 

@@ -104,7 +104,8 @@ class DocServer:
                                          fuse_w=cfg.fuse_w,
                                          tracer=self.tracer,
                                          recorder=self.recorder,
-                                         flow=self.flow)
+                                         flow=self.flow,
+                                         pipeline_ticks=cfg.pipeline_ticks)
         self.tick_no = 0
         self._profiling = False
 
@@ -142,6 +143,13 @@ class DocServer:
         self._profile_hook()
         return self.batcher.tick(self.tick_no)
 
+    def flush_pipeline(self) -> None:
+        """Sync every in-flight pipelined tick (no-op in the serial
+        loop).  Latency percentiles and end-of-run verification call
+        this so the last tick's device completion is stamped; emits no
+        trace events, so the logical stream stays mode-invariant."""
+        self.batcher.flush_pipeline()
+
     def close_obs(self) -> None:
         """Finalize observability at end of run: stop a still-running
         profiler capture (a run shorter than ``profile_ticks`` would
@@ -149,6 +157,7 @@ class DocServer:
         profiler running into the next server) and close the trace
         file. Idempotent; drivers (loadgen, probes) call it on
         teardown."""
+        self.flush_pipeline()
         if self._profiling:
             import jax
 
@@ -196,8 +205,10 @@ class DocServer:
         blocked in causal buffers need peer re-delivery, not ticks."""
         for i in range(max_ticks):
             if not any(d.events for d in self.router.docs.values()):
+                self.flush_pipeline()
                 return i
             self.tick()
+        self.flush_pipeline()
         return max_ticks
 
     # -- inspection / verification ------------------------------------------
@@ -233,7 +244,15 @@ class DocServer:
         return self.flow.report(expect_terminal=expect_terminal)
 
     def latency_summary(self) -> Dict[str, float]:
-        """Admission->applied latency percentiles in microseconds."""
+        """Admission->applied latency percentiles in microseconds.
+        Flushes the pipeline first: an in-flight tick's events are not
+        stamped until their device work completes.  With pipelining on,
+        a tick's events are stamped at its STAGED sync (the next tick's
+        barrier slot) — an upper bound that can run up to one tick of
+        host wall past true device completion (JAX exposes no per-array
+        completion time); results are not observable to readers before
+        that sync either way."""
+        self.flush_pipeline()
         us = [s * 1e6 for s in self.batcher.latency_samples]
         out = {k: round(v, 1)
                for k, v in percentiles(us, (50, 99)).items()}
@@ -247,6 +266,10 @@ class DocServer:
         counters (ISSUE 6): how many compiled rows the per-doc tick
         fusion eliminated (= bucket occupancy gained) and the
         per-shape histogram."""
+        # Flush like latency_summary does: an in-flight tick's stall/
+        # window is not accounted until its staged sync, and the two
+        # summaries must not disagree about the same run.
+        self.flush_pipeline()
         ms = [s * 1e3 for s in self.batcher.tick_wall_samples]
         out = {k: round(v, 3)
                for k, v in percentiles(ms, (50, 99)).items()}
@@ -280,6 +303,15 @@ class DocServer:
                     ("min", "max", "p50", "p99", "count")):
                 out[key] = c[key]
         out["device_compiles"] = c.get("device_compiles", 0)
+        # Pipelined tick (ISSUE 12): how much of the measured device-
+        # sync demand the staged sync hid under host work (0.0 in the
+        # serial loop), the configured-vs-effective depth, and the
+        # residual stall the overlap could not absorb.
+        out["pipeline_ticks"] = self.batcher.effective_pipeline_ticks()
+        out["pipeline_overlap_frac"] = round(
+            self.batcher.pipeline_overlap_frac(), 4)
+        out["pipeline_stall_ms_total"] = round(
+            self.batcher.sync_stall_s * 1e3, 3)
         # Flight-recorder visibility (ISSUE 10 satellite): how many
         # post-mortem bundles this run wrote and how many same-reason
         # repeats were suppressed — a nonzero suppressed count in a
