@@ -1,0 +1,148 @@
+"""ISSUE 13 proof probe: tcrlint gate wall cost + pipeline-aliasing
+sanitizer overhead at the 200-doc acceptance shape.
+
+Three measurements, committed as ``perf/lint_sanitize_r15.json``:
+
+1. **lint wall** — one subprocess run of the shared gate entry point
+   (``python -m text_crdt_rust_tpu.analysis.lint --json``) over the
+   package: must exit 0 and stay under the 10s tier-1 design target;
+2. **sanitizer overhead** — same-seed 200-doc × 60-tick × 10%-fault
+   loadgen arms (pipeline depth 2) with ``sanitize_pipeline`` off vs
+   on, min-of-``--reps`` loop wall each: the on-arm must stay inside
+   the PERF.md §14 5% bar;
+3. **logical invisibility** — the two arms' logical trace streams must
+   be byte-identical (the sanitizer may only *observe*).
+
+Usage: ``python perf/lint_sanitize_probe.py [--docs 200 --ticks 60
+--reps 2 --out perf/lint_sanitize_r15.json]``; exits 1 when any claim
+fails so the armed silicon chain can gate on it.
+"""
+import argparse
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from text_crdt_rust_tpu.config import ServeConfig  # noqa: E402
+from text_crdt_rust_tpu.serve.loadgen import ServeLoadGen  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _one_run(sanitize: bool, a) -> dict:
+    cfg = ServeConfig(engine="flat", pipeline_ticks=2,
+                      sanitize_pipeline=sanitize, trace_keep=True)
+    gen = ServeLoadGen(docs=a.docs, agents_per_doc=3, ticks=a.ticks,
+                       events_per_tick=48, fault_rate=0.10, seed=7,
+                       cfg=cfg)
+    rep = gen.run()
+    assert rep["converged"], "probe arm diverged"
+    digest = hashlib.sha256(
+        gen.server.tracer.logical_bytes()).hexdigest()
+    return {"loop_wall_s": rep["device_ticks_wall_s"],
+            "wall_s": rep["wall_s"],
+            "trace_sha256": digest,
+            "sanitize_checks": rep["pipeline"]["sanitize_checks"],
+            "overlap_frac": rep["pipeline"]["overlap_frac"]}
+
+
+def run_arms(a) -> tuple:
+    """Min-of-reps per arm, arms INTERLEAVED (off, on, off, on, ...):
+    this shared box drifts the same serial workload 11.6-17.0s across
+    sessions (PERF.md §17), so back-to-back pairing — not arm blocks —
+    is what isolates the sanitizer's own cost."""
+    best = {False: None, True: None}
+    for _ in range(a.reps):
+        for arm in (False, True):
+            cur = _one_run(arm, a)
+            if (best[arm] is None
+                    or cur["loop_wall_s"] < best[arm]["loop_wall_s"]):
+                best[arm] = cur
+    for arm in best.values():
+        arm["reps"] = a.reps
+    return best[False], best[True]
+
+
+def fingerprint_microbench() -> dict:
+    """Direct per-call cost of the CRC fingerprint at the serve tick
+    shapes — the noise-free number the loop-wall diff approximates."""
+    from text_crdt_rust_tpu.ops import batch as B
+    from text_crdt_rust_tpu.serve.batcher import _op_fingerprints
+
+    out = {}
+    for bucket in (32, 128):
+        stacked = B.stack_ops(
+            [B.pad_ops(B.empty_ops(16), bucket) for _ in range(16)])
+        t0 = time.perf_counter()
+        n = 200
+        for _ in range(n):
+            _op_fingerprints(stacked)
+        out[f"ms_per_check_b{bucket}"] = round(
+            (time.perf_counter() - t0) / n * 1e3, 4)
+    return out
+
+
+def run_lint_gate() -> dict:
+    t0 = time.perf_counter()
+    r = subprocess.run(
+        [sys.executable, "-m", "text_crdt_rust_tpu.analysis.lint",
+         "--json"],
+        capture_output=True, text=True, timeout=300, cwd=REPO)
+    wall = time.perf_counter() - t0
+    out = json.loads(r.stdout) if r.stdout.strip() else {}
+    return {"rc": r.returncode, "wall_s": round(wall, 3),
+            "files": out.get("stats", {}).get("files"),
+            "findings": len(out.get("findings", [])),
+            "ruff_available": out.get("ruff_available")}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--docs", type=int, default=200)
+    ap.add_argument("--ticks", type=int, default=60)
+    ap.add_argument("--reps", type=int, default=2)
+    ap.add_argument("--out", default="perf/lint_sanitize_r15.json")
+    a = ap.parse_args(argv)
+
+    lint = run_lint_gate()
+    off, on = run_arms(a)
+    overhead = (on["loop_wall_s"] - off["loop_wall_s"]) / off["loop_wall_s"]
+    result = {
+        "probe": "lint_sanitize_r15",
+        "shape": {"docs": a.docs, "agents": 3, "ticks": a.ticks,
+                  "events_per_tick": 48, "fault_rate": 0.10, "seed": 7,
+                  "pipeline_ticks": 2, "reps": a.reps},
+        "lint": lint,
+        "fingerprint_cost": fingerprint_microbench(),
+        "sanitize_off": off,
+        "sanitize_on": on,
+        "sanitize_overhead_frac": round(overhead, 4),
+        "byte_identical": on["trace_sha256"] == off["trace_sha256"],
+        "claims": {
+            "lint_gate_clean": lint["rc"] == 0,
+            "lint_under_10s": lint["wall_s"] < 10.0,
+            "sanitizer_under_5pct": overhead < 0.05,
+            "logical_stream_byte_identical":
+                on["trace_sha256"] == off["trace_sha256"],
+        },
+    }
+    ok = all(result["claims"].values())
+    result["ok"] = ok
+    path = os.path.join(REPO, a.out) if not os.path.isabs(a.out) else a.out
+    with open(path, "w") as f:
+        json.dump(result, f, indent=1)
+        f.write("\n")
+    print(json.dumps(result, indent=1))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,92 @@
+#!/bin/bash
+# Round-13 recovery watcher (ISSUE 13 / ROADMAP #1): supersedes
+# when_up_r12.sh and keeps its gate chain — matmul tunnel probe ->
+# compile pin -> fused kevin device smoke -> pipelined serve device
+# smoke -> fused serve-lanes smoke -> kevin full 5M -> the remaining
+# rows via --merge-rows -> the COST LEDGER device re-record.  New in
+# r13: a SANITIZED pipelined serve device smoke right after the plain
+# pipelined one — the aliasing sanitizer's first silicon run.  On a
+# real chip async dispatch is genuinely asynchronous (device steps
+# take ~ms, not the CPU formality), so this is where a host write
+# racing an in-flight step would actually corrupt: the sanitizer must
+# come up clean there AND stay byte-identical, or the pipelined tick
+# is not safe at silicon latencies.  Safe to re-run; appends to
+# perf/when_up_r13.log.
+set -u
+cd /root/repo
+while true; do
+  if timeout 240 python -c "
+import jax, numpy as np, jax.numpy as jnp
+x = jnp.ones((128,128), jnp.bfloat16)
+assert float(np.asarray(x @ x)[0,0]) == 128.0
+" >/dev/null 2>&1; then
+    echo "$(date -u +%H:%M:%S) tunnel is back (r13 watcher)" >> perf/when_up_r13.log
+    break
+  fi
+  echo "$(date -u +%H:%M:%S) still down (r13)" >> perf/when_up_r13.log
+  sleep 120
+done
+timeout 2400 python perf/compile_pin.py >> perf/compile_pin_r13.log 2>&1 \
+  || echo "PIN FAILED/TIMED OUT rc=$? - investigate before trusting bench" \
+       >> perf/compile_pin_r13.log
+# Fused-kernel device smoke first: a tiny fused kevin (2048 prepends,
+# W=8) proves the W-row splice compiles on real Mosaic before
+# committing to the 40-min full run.
+timeout 1800 python bench.py --config kevin --smoke --no-probe \
+  >> perf/when_up_r13.log 2>&1 \
+  || { echo "fused kevin device smoke FAILED rc=$? - NOT re-recording" \
+         >> perf/when_up_r13.log; exit 1; }
+# Pipelined serve device smoke: the double-buffered tick on the flat
+# backend, on-device — the staged sync overlapping real device steps,
+# convergence + lane bit-identity still green.
+timeout 1800 python -m text_crdt_rust_tpu.serve.loadgen --device \
+  --docs 24 --ticks 10 --pipeline-ticks 2 \
+  >> perf/when_up_r13.log 2>&1 \
+  || { echo "pipelined serve device smoke FAILED rc=$? - NOT re-recording" \
+         >> perf/when_up_r13.log; exit 1; }
+# SANITIZED pipelined serve device smoke (new in r13): the aliasing
+# sanitizer under real async dispatch.  A failure here is a REAL
+# host-write-races-device-step bug the CPU arms could never exhibit —
+# stop the chain and read the named tick/shard/array.
+timeout 1800 python -m text_crdt_rust_tpu.serve.loadgen --device \
+  --docs 24 --ticks 10 --pipeline-ticks 2 --sanitize-pipeline \
+  >> perf/when_up_r13.log 2>&1 \
+  || { echo "SANITIZED pipelined device smoke FAILED rc=$? - aliasing " \
+            "race on silicon? NOT re-recording" \
+         >> perf/when_up_r13.log; exit 1; }
+# Fused serve-lanes loadgen smoke — the blocked mixed kernel's fused
+# splice + the serve stack's fused ticks on device (the lanes backend
+# clamps the pipeline to serial; that clamp is part of the smoke).
+timeout 1800 python -m text_crdt_rust_tpu.serve.loadgen --device \
+  --docs 24 --ticks 10 --engine rle-lanes-mixed \
+  >> perf/when_up_r13.log 2>&1 \
+  || { echo "fused serve-lanes device smoke FAILED rc=$? - NOT re-recording" \
+         >> perf/when_up_r13.log; exit 1; }
+# Headline: kevin at full 5M, fused W=64 (rle-hbm-fused row).
+timeout 7200 python bench.py --config kevin --merge-rows --no-probe \
+  >> perf/bench_kevin_r13.log 2>&1 \
+  || echo "kevin re-record FAILED rc=$?" >> perf/when_up_r13.log
+# Remaining rows, most verdict-critical first; every merged row is
+# ledger_version-stamped by the exporter.
+for cfg in northstar 4 5r 5 serve serve-lanes sp; do
+  timeout 7200 python bench.py --config "$cfg" --merge-rows --no-probe \
+    >> "perf/bench_cfg${cfg}_r13.log" 2>&1 \
+    || echo "config $cfg re-record FAILED rc=$?" >> perf/when_up_r13.log
+done
+# The cost-ledger silicon cells: device-step wall histograms +
+# real-HLO costs + the flow-device per-op provenance cell, appended to
+# the committed ledger (cpu cells untouched).
+timeout 3600 python perf/cost_ledger_probe.py --device \
+  >> perf/when_up_r13.log 2>&1 \
+  || echo "ledger device re-record FAILED rc=$?" >> perf/when_up_r13.log
+# And prove the cpu contracts still hold from this very checkout:
+# cost ledger + the tcrlint gate (a drifted tree must not re-record).
+timeout 1800 env JAX_PLATFORMS=cpu python bench.py --check-ledger \
+  >> perf/when_up_r13.log 2>&1 \
+  || echo "LEDGER CHECK FAILED rc=$? - cpu cost contract drifted" \
+       >> perf/when_up_r13.log
+timeout 600 env JAX_PLATFORMS=cpu python -m text_crdt_rust_tpu.analysis.lint \
+  >> perf/when_up_r13.log 2>&1 \
+  || echo "TCRLINT FAILED rc=$? - determinism/schema finding on this checkout" \
+       >> perf/when_up_r13.log
+echo "$(date -u +%H:%M:%S) r13 re-record done" >> perf/when_up_r13.log

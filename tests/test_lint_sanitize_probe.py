@@ -1,0 +1,60 @@
+"""Committed-claims + smoke coverage for perf/lint_sanitize_probe.py.
+
+Tier-1 keeps two cheap guarantees: the committed r15 JSON still claims
+what PERF.md §18 cites (no silent drift between the doc and the
+artifact), and the probe module itself still runs end to end at a tiny
+shape.  The full 200-doc re-measure lives in ``slow``.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+COMMITTED = os.path.join(REPO, "perf", "lint_sanitize_r15.json")
+
+
+def test_committed_probe_claims_hold():
+    with open(COMMITTED) as f:
+        r = json.load(f)
+    assert r["ok"] is True
+    assert r["claims"] == {
+        "lint_gate_clean": True,
+        "lint_under_10s": True,
+        "sanitizer_under_5pct": True,
+        "logical_stream_byte_identical": True,
+    }
+    assert r["byte_identical"] is True
+    assert r["shape"]["docs"] == 200 and r["shape"]["ticks"] == 60
+    assert r["sanitize_on"]["sanitize_checks"] > 0
+    assert r["sanitize_off"]["sanitize_checks"] == 0
+    assert r["sanitize_overhead_frac"] < 0.05
+    assert r["lint"]["wall_s"] < 10.0 and r["lint"]["findings"] == 0
+
+
+def test_probe_smoke_tiny_shape(tmp_path):
+    out = tmp_path / "smoke.json"
+    r = subprocess.run(
+        [sys.executable, "perf/lint_sanitize_probe.py", "--docs", "6",
+         "--ticks", "6", "--reps", "1", "--out", str(out)],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, (r.stdout[-3000:], r.stderr[-2000:])
+    smoke = json.loads(out.read_text())
+    assert smoke["byte_identical"] is True
+    assert smoke["claims"]["lint_gate_clean"] is True
+
+
+@pytest.mark.slow
+def test_probe_full_shape_remeasure(tmp_path):
+    out = tmp_path / "full.json"
+    r = subprocess.run(
+        [sys.executable, "perf/lint_sanitize_probe.py",
+         "--out", str(out)],
+        capture_output=True, text=True, timeout=1800, cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, (r.stdout[-3000:], r.stderr[-2000:])
+    full = json.loads(out.read_text())
+    assert full["ok"] is True

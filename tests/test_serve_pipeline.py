@@ -31,8 +31,12 @@ LOGICAL_SRV_KEYS = ("device_ticks", "device_steps", "evictions",
 
 
 def _loadgen_run(pipeline_ticks: int):
+    # sanitize_pipeline rides the PIPELINED arm (ISSUE 13: left on in
+    # the serve tests): the byte-identity assert below then doubles as
+    # the sanitized-vs-unsanitized logical-invisibility proof.
     cfg = ServeConfig(engine="flat", num_shards=2, lanes_per_shard=4,
                       pipeline_ticks=pipeline_ticks, trace_keep=True,
+                      sanitize_pipeline=pipeline_ticks > 1,
                       flow_sample_mod=1)
     gen = ServeLoadGen(docs=8, agents_per_doc=2, ticks=10,
                        events_per_tick=12, fault_rate=0.10, seed=7,
@@ -72,6 +76,7 @@ def _direct_server_run(pipeline_ticks: int):
     checkpoint boundary a deferred sync must not smear state across."""
     cfg = ServeConfig(engine="flat", num_shards=1, lanes_per_shard=2,
                       pipeline_ticks=pipeline_ticks, trace_keep=True,
+                      sanitize_pipeline=pipeline_ticks > 1,
                       flow_sample_mod=1)
     server = DocServer(cfg)
     for d in range(3):

@@ -1,0 +1,121 @@
+"""Pipeline aliasing sanitizer (ISSUE 13 tentpole, runtime half).
+
+The double-buffered tick (ISSUE 12) opened a hazard class with no
+tooling watching it: host code mutating arrays an in-flight device
+step still reads (JAX's CPU zero-copy conversion can alias the numpy
+buffers the compiled step consumes).  ``ServeConfig.sanitize_pipeline``
+CRC-fingerprints every dispatched tick's op tensors at the dispatch
+edge and re-checks them at that entry's staged sync.  Contract:
+
+- an injected host write to an in-flight tick's arrays fails LOUD,
+  naming the tick, shard and array;
+- a clean sanitized run is logically invisible: byte-identical trace
+  stream, identical convergence, zero new events;
+- cheap enough to leave on in the serve tests (the §18 overhead
+  measurement rides perf/lint_sanitize_probe.py).
+"""
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from text_crdt_rust_tpu.config import ServeConfig  # noqa: E402
+from text_crdt_rust_tpu.serve.batcher import (  # noqa: E402
+    PipelineAliasingError,
+)
+from text_crdt_rust_tpu.serve.loadgen import ServeLoadGen  # noqa: E402
+from text_crdt_rust_tpu.serve.server import DocServer  # noqa: E402
+
+
+def _server(pipeline_ticks=2, sanitize=True):
+    cfg = ServeConfig(engine="flat", num_shards=2, lanes_per_shard=2,
+                      pipeline_ticks=pipeline_ticks,
+                      sanitize_pipeline=sanitize, trace_keep=True)
+    srv = DocServer(cfg)
+    for d in range(3):
+        srv.admit_doc(f"doc{d}")
+    return srv
+
+
+def test_injected_race_fails_naming_tick_shard_array():
+    srv = _server()
+    srv.submit_local("doc0", "alice", pos=0, ins_content="hello")
+    srv.tick()
+    entry = srv.batcher._inflight[-1]
+    assert entry["guards"], "dispatched tick must carry guards"
+    guard = entry["guards"][0]
+    # The host write racing the in-flight device step: stack_ops hands
+    # the backend plain numpy arrays, so this is exactly the aliasing
+    # surface.
+    np.asarray(guard["arrays"].chars)[0] += 1
+    with pytest.raises(PipelineAliasingError) as ei:
+        srv.flush_pipeline()
+    msg = str(ei.value)
+    assert f"tick {entry['tick']}" in msg
+    assert f"shard {guard['shard']}" in msg
+    assert "'chars'" in msg
+    srv.close_obs()
+
+
+def test_race_detected_at_staged_sync_not_only_flush():
+    """The mid-run spelling: the NEXT tick's staged sync (not an
+    explicit flush) is where the re-check fires."""
+    srv = _server()
+    srv.submit_local("doc0", "alice", pos=0, ins_content="hello")
+    srv.tick()
+    guard = srv.batcher._inflight[-1]["guards"][0]
+    np.asarray(guard["arrays"].pos)[0] += 3
+    with pytest.raises(PipelineAliasingError, match="'pos'"):
+        for _ in range(3):  # next device dispatch syncs the old entry
+            srv.submit_local("doc0", "alice", pos=0, ins_content="x")
+            srv.tick()
+    srv.close_obs()
+
+
+def test_clean_sanitized_run_checks_and_converges():
+    srv = _server()
+    for i in range(6):
+        for d in range(3):
+            srv.submit_local(f"doc{d}", "alice", pos=0,
+                             ins_content=f"t{i}d{d}")
+        srv.tick()
+    srv.drain()
+    assert all(srv.verify_doc(f"doc{d}") for d in range(3))
+    assert srv.counters.summary()["sanitize_checks"] > 0
+    srv.close_obs()
+
+
+def test_sanitizer_active_in_serial_loop_too():
+    srv = _server(pipeline_ticks=1)
+    srv.submit_local("doc0", "alice", pos=0, ins_content="hi")
+    srv.tick()
+    assert srv.counters.summary()["sanitize_checks"] > 0
+    srv.close_obs()
+
+
+def _loadgen_run(sanitize: bool):
+    cfg = ServeConfig(engine="flat", num_shards=2, lanes_per_shard=4,
+                      pipeline_ticks=2, sanitize_pipeline=sanitize,
+                      trace_keep=True, flow_sample_mod=1)
+    gen = ServeLoadGen(docs=8, agents_per_doc=2, ticks=10,
+                       events_per_tick=12, fault_rate=0.10, seed=7,
+                       cfg=cfg)
+    rep = gen.run()
+    return rep, gen.server.tracer.logical_bytes()
+
+
+def test_sanitizer_on_is_byte_identical_under_faults():
+    """Same-seed sanitizer-on/off loadgen runs (faults + evictions):
+    identical logical streams, identical convergence — detection must
+    be free of logical side effects, or turning it on to debug a race
+    would change the run being debugged."""
+    rep_on, trace_on = _loadgen_run(True)
+    rep_off, trace_off = _loadgen_run(False)
+    assert rep_on["converged"] and rep_off["converged"]
+    assert trace_on == trace_off
+    assert rep_on["pipeline"]["sanitize"] is True
+    assert rep_on["pipeline"]["sanitize_checks"] > 0
+    assert rep_off["pipeline"]["sanitize_checks"] == 0
+    assert rep_on["flow"]["spans"] == rep_off["flow"]["spans"]

@@ -1,0 +1,82 @@
+"""TCR-R00x: recompile hazards — uncached kernel/jit build sites.
+
+A ``pl.pallas_call`` or ``jax.jit(lambda ...)`` constructed inside a
+plain function builds a FRESH traced program every call: on CPU
+interpret that re-trace dominates fixed-shape suites (the PR-6 finding
+that took tier-1 from 779s to 712s when ``ops/rle.py`` adopted the
+``_build_call`` pattern), and on TPU it is a 5-30s Mosaic recompile
+per call — the dynamic-shape leak the serve batcher's step buckets
+exist to prevent.  The sanctioned shapes are:
+
+- a module-level ``jax.jit`` (built once at import), or a ``@jax.jit``
+  / ``@partial(jax.jit, ...)`` decorator (jax caches per shape);
+- a build site inside a function decorated ``@functools.lru_cache``
+  keyed by the static shape tuple — the ``_build_call`` pattern every
+  shipped kernel module uses;
+- an audited one-shot builder, allowlisted with its justification.
+
+**TCR-R001** flags uncached ``pallas_call`` sites, **TCR-R002**
+uncached ``jax.jit(...)`` call sites (decorator usage never flags).
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .tcrlint import FileContext, Finding, dotted_name
+
+CACHING_DECORATORS = {"lru_cache", "cache"}
+
+
+def _is_cached(ctx: FileContext, node: ast.AST) -> bool:
+    """True when any enclosing function is lru-cached."""
+    for fn in ctx.enclosing_functions(node):
+        for dec in fn.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            name = dotted_name(target) or ""
+            if name.split(".")[-1] in CACHING_DECORATORS:
+                return True
+    return False
+
+
+def _in_decorator(ctx: FileContext, node: ast.AST) -> bool:
+    """True when ``node`` sits inside a decorator expression."""
+    cur = node
+    parent = ctx.parent_of(cur)
+    while parent is not None:
+        if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            return cur in parent.decorator_list
+        cur, parent = parent, ctx.parent_of(parent)
+    return False
+
+
+def check(ctx: FileContext) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func) or ""
+        leaf = name.split(".")[-1]
+        if leaf == "pallas_call":
+            if not _is_cached(ctx, node):
+                out.append(ctx.finding(
+                    "TCR-R001", node,
+                    "pallas_call built outside an lru-cached builder — "
+                    "every kernel build site must be shape-keyed "
+                    "(@functools.lru_cache on a _build_call(shape...) "
+                    "function) or it re-traces/recompiles per call"))
+        elif name in ("jax.jit", "jit") and leaf == "jit":
+            if _in_decorator(ctx, node):
+                continue  # @partial(jax.jit, ...) / @jax.jit — cached by jax
+            if not ctx.enclosing_functions(node):
+                continue  # module level: built once at import
+            if not _is_cached(ctx, node):
+                out.append(ctx.finding(
+                    "TCR-R002", node,
+                    "jax.jit(...) constructed inside an uncached "
+                    "function — each call builds a fresh jit object "
+                    "that re-traces; cache the build by static shape "
+                    "(the _build_call pattern) or allowlist the "
+                    "audited one-shot builder"))
+    return out

@@ -1,0 +1,153 @@
+"""tcrlint CLI — ``python -m text_crdt_rust_tpu.analysis.lint``.
+
+One entry point for ALL of the project's static gates (the tier-1
+lint test runs exactly this module):
+
+1. **tcrlint** — the project-invariant families (wall-clock
+   segregation, determinism hazards, schema drift, recompile hazards,
+   F401 fallback) over the package;
+2. **ruff** — the third-party baseline (``pyproject.toml
+   [tool.ruff]``, pyflakes+isort-level rules) when the binary is
+   installed; its absence downgrades to the built-in TCR-F401
+   fallback, reported in the summary so the gate's coverage is never
+   silently ambiguous.
+
+Exit codes: 0 clean, 1 findings (each printed as
+``path:line: CHECK-ID message``), 2 usage/config error.
+
+``--update-pins`` rewrites ``SCHEMA_PINS.json`` from the live schema
+surfaces (commit it together with the version bump that motivated it).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+from .tcrlint import ALLOWLIST_PATH, PINS_PATH, run_lint
+
+#: Default lint target, relative to the repo root.
+DEFAULT_TARGET = "text_crdt_rust_tpu"
+
+
+def repo_root() -> str:
+    """The repo root = the parent of the installed package directory
+    (bench.py and pyproject.toml live there)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def run_ruff(root: str, paths: List[str]) -> Optional[dict]:
+    """Run ruff over ``paths`` when installed; None when unavailable
+    (the caller reports the downgrade).  Findings come back in the
+    same path:line shape tcrlint uses."""
+    exe = shutil.which("ruff")
+    argv = None
+    if exe:
+        argv = [exe, "check", "--output-format", "concise", *paths]
+    else:
+        try:  # pip-installed module without a PATH shim
+            import ruff  # noqa: F401
+
+            argv = [sys.executable, "-m", "ruff", "check",
+                    "--output-format", "concise", *paths]
+        except ImportError:
+            return None
+    r = subprocess.run(argv, capture_output=True, text=True, cwd=root,
+                       timeout=300)
+    lines = [ln for ln in r.stdout.splitlines()
+             if ln.strip() and not ln.startswith(("Found ", "warning:"))]
+    return {"rc": r.returncode, "lines": lines,
+            "stderr": r.stderr.strip()}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m text_crdt_rust_tpu.analysis.lint",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*", default=None,
+                    help=f"files/dirs to lint, relative to --root "
+                         f"(default: {DEFAULT_TARGET})")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: derived from the package "
+                         "location)")
+    ap.add_argument("--allowlist", default=None,
+                    help="allowlist JSON (default: the committed "
+                         "analysis/LINT_ALLOWLIST.json)")
+    ap.add_argument("--pins", default=None,
+                    help="schema pins JSON (default: the committed "
+                         "analysis/SCHEMA_PINS.json)")
+    ap.add_argument("--update-pins", action="store_true",
+                    help="rewrite the schema pins from the live "
+                         "surfaces instead of checking them")
+    ap.add_argument("--no-ruff", action="store_true",
+                    help="skip the third-party ruff baseline")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable report on stdout")
+    a = ap.parse_args(argv)
+
+    root = os.path.abspath(a.root) if a.root else repo_root()
+    if not os.path.isdir(root):
+        print(f"lint root {root!r} is not a directory", file=sys.stderr)
+        return 2
+    paths = a.paths or [DEFAULT_TARGET]
+    for p in paths:
+        if not os.path.exists(os.path.join(root, p)):
+            print(f"lint target {p!r} not found under {root}",
+                  file=sys.stderr)
+            return 2
+
+    t0 = time.perf_counter()  # lint wall for the summary line only
+    try:
+        findings, stats = run_lint(
+            root, paths,
+            allowlist_path=a.allowlist or ALLOWLIST_PATH,
+            pins_path=a.pins or PINS_PATH,
+            update_pins=a.update_pins,
+            # Stale-grant findings only for the full default target: a
+            # partial lint never walked most granted files.
+            check_stale_allowlist=not a.paths)
+    except ValueError as e:  # malformed allowlist
+        print(f"tcrlint config error: {e}", file=sys.stderr)
+        return 2
+
+    ruff = None if a.no_ruff else run_ruff(root, paths)
+    ruff_lines = ruff["lines"] if ruff else []
+    wall = time.perf_counter() - t0
+
+    if a.as_json:
+        print(json.dumps({
+            "ok": not findings and not ruff_lines,
+            "findings": [f.format() for f in findings],
+            "ruff": (None if ruff is None
+                     else {"rc": ruff["rc"], "findings": ruff_lines}),
+            "ruff_available": ruff is not None,
+            "stats": stats, "wall_s": round(wall, 3),
+        }, indent=1))
+    else:
+        for f in findings:
+            print(f.format())
+        for ln in ruff_lines:
+            print(f"{ln}  [ruff]")
+        ruff_note = ("ruff baseline clean" if ruff and not ruff_lines
+                     else f"ruff: {len(ruff_lines)} finding(s)" if ruff
+                     else "ruff not installed — built-in TCR-F401 "
+                          "fallback covered the F-level floor")
+        print(f"tcrlint: {stats['files']} files, "
+              f"{len(findings)} finding(s), "
+              f"{stats['allow_entries']} allowlist grants; {ruff_note} "
+              f"({wall:.1f}s)", file=sys.stderr)
+    if a.update_pins and not a.as_json:
+        print(f"schema pins rewritten: {a.pins or PINS_PATH}",
+              file=sys.stderr)
+    return 1 if (findings or ruff_lines) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

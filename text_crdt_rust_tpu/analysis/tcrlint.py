@@ -1,0 +1,263 @@
+"""The tcrlint engine: file walking, AST context, allowlist, runner.
+
+Design points (shared by every check module):
+
+- **Findings name file:line + check id** — the CLI prints
+  ``path:line: TCR-X000 message`` and exits 1, so a violation reads
+  like a compiler error, not a style nag.
+- **The allowlist is scoped, not line-pinned.**  Entries match
+  ``(check, path, scope)`` where scope is the dotted enclosing
+  class/function chain (``ContinuousBatcher.tick``; ``<module>`` for
+  module level, ``*`` for the whole file).  Line numbers churn on every
+  edit; scopes only churn when the audited code actually moves — and a
+  *stale* entry (matching nothing anymore) is itself a finding
+  (TCR-A001), so dead grants cannot accumulate.
+- **Deterministic by construction**: files walk sorted, findings sort
+  by (path, line, check) — the lint's own output is diffable, which is
+  what lets the self-test pin exact findings.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: Committed allowlist + schema pins live next to the engine.
+ALLOWLIST_PATH = os.path.join(os.path.dirname(__file__),
+                              "LINT_ALLOWLIST.json")
+PINS_PATH = os.path.join(os.path.dirname(__file__), "SCHEMA_PINS.json")
+
+#: Directories never walked (build junk; native/ holds generated .so
+#: trees; spool dirs can appear under a dev checkout).
+SKIP_DIRS = {"__pycache__", ".git", "build", ".pytest_cache", "node_modules"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint finding; sorts by (path, line, check) for stable output."""
+
+    check: str       # "TCR-W001"
+    path: str        # root-relative, forward slashes
+    line: int
+    scope: str       # dotted enclosing defs, "<module>" at top level
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: {self.check} {self.message}"
+
+    def sort_key(self) -> Tuple[str, int, str]:
+        return (self.path, self.line, self.check)
+
+
+class FileContext:
+    """Parsed module + the scope/parent maps the checks share."""
+
+    def __init__(self, rel: str, source: str, tree: ast.Module):
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        self.scopes: Dict[ast.AST, str] = {}
+        self._annotate()
+
+    def _annotate(self) -> None:
+        def walk(node: ast.AST, scope: List[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+                self.scopes[child] = ".".join(scope) or "<module>"
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    walk(child, scope + [child.name])
+                else:
+                    walk(child, scope)
+
+        walk(self.tree, [])
+
+    def scope_of(self, node: ast.AST) -> str:
+        return self.scopes.get(node, "<module>")
+
+    def parent_of(self, node: ast.AST) -> Optional[ast.AST]:
+        return self.parents.get(node)
+
+    def enclosing_functions(self, node: ast.AST) -> List[ast.FunctionDef]:
+        """Innermost-first chain of enclosing function defs."""
+        out: List[ast.FunctionDef] = []
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append(cur)
+            cur = self.parents.get(cur)
+        return out
+
+    def finding(self, check: str, node: ast.AST, message: str) -> Finding:
+        return Finding(check=check, path=self.rel,
+                       line=getattr(node, "lineno", 1),
+                       scope=self.scope_of(node), message=message)
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+# -- file walking -------------------------------------------------------------
+
+
+def iter_py_files(root: str, paths: Optional[Sequence[str]] = None
+                  ) -> Iterable[str]:
+    """Root-relative .py paths under ``paths`` (files or directories),
+    sorted — the lint practices the determinism it preaches."""
+    targets = [os.path.join(root, p) for p in paths] if paths else [root]
+    out: List[str] = []
+    for target in targets:
+        if os.path.isfile(target):
+            out.append(os.path.relpath(target, root))
+            continue
+        for dirpath, dirnames, filenames in os.walk(target):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in SKIP_DIRS
+                                 and not d.startswith("."))
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.relpath(
+                        os.path.join(dirpath, fn), root))
+    return sorted(dict.fromkeys(p.replace(os.sep, "/") for p in out))
+
+
+# -- allowlist ----------------------------------------------------------------
+
+
+def load_allowlist(path: str = ALLOWLIST_PATH) -> List[dict]:
+    """Entries ``{"check", "path", "scope", "why"}``; ``scope`` ``"*"``
+    grants the whole file.  Every field is required — an unjustified
+    grant is refused at load time."""
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        data = json.load(f)
+    entries = data["allow"] if isinstance(data, dict) else data
+    for e in entries:
+        missing = [k for k in ("check", "path", "scope", "why")
+                   if not e.get(k)]
+        if missing:
+            raise ValueError(
+                f"allowlist entry {e!r} missing {missing} — every grant "
+                f"needs a check id, a path, a scope and a justification")
+    return entries
+
+
+def _entry_matches(entry: dict, finding: Finding) -> bool:
+    if entry["check"] != finding.check or entry["path"] != finding.path:
+        return False
+    if entry["scope"] == "*":
+        return True
+    # Exact scope, or a grant on an enclosing scope ("Cls" covers
+    # "Cls.method"): audits grant functions or whole classes, and a
+    # nested helper inside an audited function is the same audit.
+    return (finding.scope == entry["scope"]
+            or finding.scope.startswith(entry["scope"] + "."))
+
+
+def apply_allowlist(findings: List[Finding], entries: List[dict],
+                    allowlist_rel: str,
+                    check_stale: bool = True) -> List[Finding]:
+    """Filter allowlisted findings; a stale entry (granting nothing this
+    run) becomes a TCR-A001 finding on the allowlist file itself.
+    ``check_stale=False`` for partial-tree lints, where an unused grant
+    just means its file wasn't walked."""
+    used = [False] * len(entries)
+    kept: List[Finding] = []
+    for f in findings:
+        granted = False
+        for i, e in enumerate(entries):
+            if _entry_matches(e, f):
+                used[i] = True
+                granted = True
+        if not granted:
+            kept.append(f)
+    for i, e in enumerate(entries):
+        if check_stale and not used[i]:
+            kept.append(Finding(
+                check="TCR-A001", path=allowlist_rel, line=1,
+                scope="<allowlist>",
+                message=(f"stale allowlist entry: {e['check']} "
+                         f"{e['path']}::{e['scope']} matched no finding "
+                         f"— delete it or re-justify")))
+    return kept
+
+
+# -- runner -------------------------------------------------------------------
+
+
+def _check_modules():
+    from . import (checks_determinism, checks_pyflakes, checks_recompile,
+                   checks_schema, checks_wallclock)
+
+    return (checks_wallclock, checks_determinism, checks_schema,
+            checks_recompile, checks_pyflakes)
+
+
+def run_lint(root: str, paths: Optional[Sequence[str]] = None, *,
+             allowlist_path: str = ALLOWLIST_PATH,
+             pins_path: str = PINS_PATH,
+             update_pins: bool = False,
+             check_stale_allowlist: Optional[bool] = None
+             ) -> Tuple[List[Finding], dict]:
+    """Lint ``paths`` (default: the whole root) and return
+    ``(findings, stats)``.  Findings are sorted and allowlist-filtered;
+    ``stats`` counts files/raw findings per check for the CLI summary.
+    """
+    from . import checks_schema
+
+    modules = _check_modules()
+    raw: List[Finding] = []
+    files = list(iter_py_files(root, paths))
+    skipped: List[str] = []
+    for rel in files:
+        full = os.path.join(root, rel)
+        try:
+            with open(full, encoding="utf-8") as f:
+                source = f.read()
+            tree = ast.parse(source, filename=rel)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            raw.append(Finding(check="TCR-P001", path=rel,
+                               line=getattr(e, "lineno", 1) or 1,
+                               scope="<module>",
+                               message=f"unparseable: {e}"))
+            skipped.append(rel)
+            continue
+        ctx = FileContext(rel, source, tree)
+        for mod in modules:
+            raw.extend(mod.check(ctx))
+    # Project-level pass: schema fingerprints vs the committed pins.
+    raw.extend(checks_schema.check_pins(root, pins_path,
+                                        update=update_pins))
+
+    entries = load_allowlist(allowlist_path)
+    allowlist_rel = os.path.relpath(allowlist_path, root).replace(
+        os.sep, "/")
+    if check_stale_allowlist is None:
+        # Default: stale-grant findings only on full-tree lints — a
+        # partial lint never walked most granted files.
+        check_stale_allowlist = paths is None
+    findings = apply_allowlist(sorted(raw, key=Finding.sort_key),
+                               entries, allowlist_rel,
+                               check_stale=check_stale_allowlist)
+    findings.sort(key=Finding.sort_key)
+    per_check: Dict[str, int] = {}
+    for f in findings:
+        per_check[f.check] = per_check.get(f.check, 0) + 1
+    stats = {"files": len(files), "skipped": skipped,
+             "raw_findings": len(raw), "findings": len(findings),
+             "allow_entries": len(entries), "per_check": per_check}
+    return findings, stats

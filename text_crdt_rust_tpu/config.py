@@ -225,6 +225,20 @@ class ServeConfig:
     #                            row counts at its barrier, so it
     #                            stays serial until that true-up is
     #                            pipeline-safe)
+    sanitize_pipeline: bool = False  # pipeline aliasing sanitizer
+    #                            (ISSUE 13): fingerprint (CRC32) the op
+    #                            tensors referenced by each in-flight
+    #                            tick at dispatch and re-check them at
+    #                            the staged sync — a host write racing
+    #                            an in-flight device step fails loudly
+    #                            naming the tick/shard/array instead of
+    #                            corrupting device state (JAX's CPU
+    #                            zero-copy conversion can alias the
+    #                            host buffers).  Off by default on the
+    #                            raw serving path; cheap enough
+    #                            (<5% wall, PERF.md §18) to leave on in
+    #                            the serve tests and any pipelined
+    #                            deployment being debugged
     step_buckets: tuple = (8, 32, 128)  # padded tick step shapes; a tick
     #                            drains at most step_buckets[-1] compiled
     #                            steps per doc so steady-state serving

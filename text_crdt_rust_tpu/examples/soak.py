@@ -12,7 +12,6 @@ Usage: ``python -m text_crdt_rust_tpu.examples.soak [--edits N] [--seed S]``
 """
 from __future__ import annotations
 
-import argparse
 import random
 import sys
 import time

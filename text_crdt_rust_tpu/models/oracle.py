@@ -26,7 +26,7 @@ Semantic invariants preserved bit-exactly (SURVEY §7):
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple
+from typing import List, Optional, Tuple
 
 import numpy as np
 

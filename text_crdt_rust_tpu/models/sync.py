@@ -20,7 +20,6 @@ from typing import Dict, List, Set
 
 from ..common import (
     CLIENT_INVALID,
-    ROOT_ORDER,
     RemoteDel,
     RemoteId,
     RemoteIns,

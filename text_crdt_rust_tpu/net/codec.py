@@ -29,7 +29,7 @@ the payload cursor must land exactly on the declared end.
 from __future__ import annotations
 
 import struct
-from typing import Dict, Iterable, List, Sequence, Tuple, Union
+from typing import Dict, List, Sequence, Tuple, Union
 
 from ..common import (
     RemoteDel,

@@ -36,6 +36,7 @@ engine's.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from functools import partial
 
 import jax
@@ -388,6 +389,28 @@ def make_replayer(
     staged = (padded(ops.pos), padded(ops.del_len), padded(ops.ins_len),
               padded(ops.ins_order_start))
 
+    jitted = _build_call(s_pad, batch, capacity, block_k, chunk, lmax,
+                         interpret)
+
+    def run() -> BlockedResult:
+        ol, orr, signed, rows, err = jitted(*staged)
+        return BlockedResult(
+            signed=signed, rows=rows, ol=ol[:s], orr=orr[:s], err=err,
+            block_k=block_k, num_blocks=NB, batch=batch)
+
+    return run
+
+
+@functools.lru_cache(maxsize=32)
+def _build_call(s_pad: int, batch: int, capacity: int, block_k: int,
+                chunk: int, lmax: int, interpret: bool):
+    """Shape-keyed cache (the ``rle_lanes._build_call`` pattern):
+    same-shape replays share one traced kernel — a per-call
+    ``jax.jit(lambda ...)`` re-traces the whole interpret program each
+    time, which dominates the fixed-shape test suites."""
+    NB = capacity // block_k
+    NBp = max(8, NB)
+
     smem = lambda: pl.BlockSpec(
         (chunk,), lambda i: (i,), memory_space=pltpu.SMEM)
 
@@ -428,15 +451,7 @@ def make_replayer(
         ),
         interpret=interpret,
     )
-    jitted = jax.jit(lambda a, b, c, d: call(a, b, c, d))
-
-    def run() -> BlockedResult:
-        ol, orr, signed, rows, err = jitted(*staged)
-        return BlockedResult(
-            signed=signed, rows=rows, ol=ol[:s], orr=orr[:s], err=err,
-            block_k=block_k, num_blocks=NB, batch=batch)
-
-    return run
+    return jax.jit(lambda a, b, c, d: call(a, b, c, d))
 
 
 def replay_local(

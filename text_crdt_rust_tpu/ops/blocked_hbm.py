@@ -23,7 +23,7 @@ trace (182k insertions) replays across a 128-doc lane batch in one kernel.
 """
 from __future__ import annotations
 
-import dataclasses
+import functools
 from functools import partial
 
 import jax
@@ -34,7 +34,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..common import ROOT_ORDER
-from .batch import KIND_LOCAL, OpTensors, require_unfused
+from .batch import KIND_LOCAL, require_unfused
 from .blocked import (
     BlockedResult,
     _cumsum_rows,
@@ -395,6 +395,34 @@ def make_replayer_hbm(
               staged_col(lambda o: o.ins_len),
               staged_col(lambda o: o.ins_order_start))
 
+    jitted = _build_call(G, s_pad, batch, capacity, block_k, chunk,
+                         lmax, interpret)
+
+    def run():
+        ol, orr, state, _tmp, rows, err = jitted(*staged)
+        results = [
+            BlockedResult(
+                signed=state[gi * capacity:(gi + 1) * capacity],
+                rows=rows[gi], ol=ol[gi, :lens[gi]], orr=orr[gi, :lens[gi]],
+                err=err, block_k=block_k, num_blocks=NB, batch=batch)
+            for gi in range(G)
+        ]
+        return results if grouped else results[0]
+
+    return run
+
+
+@functools.lru_cache(maxsize=32)
+def _build_call(G: int, s_pad: int, batch: int, capacity: int,
+                block_k: int, chunk: int, lmax: int, interpret: bool):
+    """Shape-keyed cache (the ``rle_lanes._build_call`` pattern):
+    same-shape replays share one traced kernel instead of re-tracing a
+    fresh ``jax.jit(lambda ...)`` per build."""
+    NB = capacity // block_k
+    NSUP = (NB + SUP - 1) // SUP
+    NBp = NSUP * SUP
+    NSUPp = max(8, ((NSUP + 7) // 8) * 8)
+
     smem = lambda: pl.BlockSpec(
         (1, chunk), lambda g, i: (g, i), memory_space=pltpu.SMEM)
 
@@ -444,20 +472,7 @@ def make_replayer_hbm(
         ),
         interpret=interpret,
     )
-    jitted = jax.jit(lambda a, b, c, d: call(a, b, c, d))
-
-    def run():
-        ol, orr, state, _tmp, rows, err = jitted(*staged)
-        results = [
-            BlockedResult(
-                signed=state[gi * capacity:(gi + 1) * capacity],
-                rows=rows[gi], ol=ol[gi, :lens[gi]], orr=orr[gi, :lens[gi]],
-                err=err, block_k=block_k, num_blocks=NB, batch=batch)
-            for gi in range(G)
-        ]
-        return results if grouped else results[0]
-
-    return run
+    return jax.jit(lambda a, b, c, d: call(a, b, c, d))
 
 
 def replay_local_hbm(ops, capacity: int, **kw):

@@ -34,6 +34,7 @@ stream), same result type, same ``blocked_to_flat`` conversion.
 """
 from __future__ import annotations
 
+import functools
 from functools import partial
 
 import jax
@@ -416,6 +417,29 @@ def make_replayer_mixed(
         ops.kind, ops.pos, ops.del_len, ops.del_target, ops.origin_left,
         ops.origin_right, ops.rank, ops.ins_len, ops.ins_order_start))
 
+    jitted = _build_call(s_pad, batch, capacity, block_k, chunk, lmax,
+                         dmax, OT, interpret)
+    tables = (oll0, orl0, rkl0)
+
+    def run() -> BlockedResult:
+        ol, orr, signed, rows, err = jitted(*staged, *tables)
+        return BlockedResult(
+            signed=signed, rows=rows, ol=ol[:s], orr=orr[:s], err=err,
+            block_k=block_k, num_blocks=NB, batch=batch)
+
+    return run
+
+
+@functools.lru_cache(maxsize=32)
+def _build_call(s_pad: int, batch: int, capacity: int, block_k: int,
+                chunk: int, lmax: int, dmax: int, OT: int,
+                interpret: bool):
+    """Shape-keyed cache (the ``rle_lanes._build_call`` pattern):
+    same-shape replays share one traced kernel instead of re-tracing a
+    fresh ``jax.jit(lambda ...)`` per build."""
+    NB = capacity // block_k
+    NBp = max(8, NB)
+
     smem = lambda: pl.BlockSpec(
         (chunk,), lambda i: (i,), memory_space=pltpu.SMEM)
 
@@ -459,16 +483,7 @@ def make_replayer_mixed(
         ),
         interpret=interpret,
     )
-    jitted = jax.jit(lambda *a: call(*a))
-    tables = (oll0, orl0, rkl0)
-
-    def run() -> BlockedResult:
-        ol, orr, signed, rows, err = jitted(*staged, *tables)
-        return BlockedResult(
-            signed=signed, rows=rows, ol=ol[:s], orr=orr[:s], err=err,
-            block_k=block_k, num_blocks=NB, batch=batch)
-
-    return run
+    return jax.jit(lambda *a: call(*a))
 
 
 def replay_mixed(ops: OpTensors, capacity: int, **kw) -> BlockedResult:

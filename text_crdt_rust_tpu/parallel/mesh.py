@@ -18,7 +18,7 @@ annotated shardings, riding ICI inside a pod and DCN across hosts.
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence
 
 import jax
 import numpy as np

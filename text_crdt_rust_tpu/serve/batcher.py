@@ -47,6 +47,30 @@ from ..utils.testdata import TestPatch
 from .router import EV_LOCAL, DocState, Event, ShardRouter
 
 
+class PipelineAliasingError(RuntimeError):
+    """A host write raced an in-flight device step (ISSUE 13): an op
+    tensor referenced by a dispatched-but-unsynced tick changed between
+    dispatch and its staged sync.  On CPU, JAX's zero-copy conversion
+    can alias the host numpy buffers the compiled step reads — this is
+    the loud version of silent device-state corruption, naming the
+    tick, shard and array so the post-mortem starts at the writer."""
+
+
+def _op_fingerprints(stacked: "B.OpTensors") -> Dict[str, int]:
+    """CRC32 per op-tensor column — the dispatch-time fingerprint the
+    staged sync re-checks.  Columns are small host arrays ([S, B] u32
+    plus the [S, B, LMAX] char block), so this is tens-of-µs cheap at
+    serve shapes."""
+    import dataclasses
+    import zlib
+
+    out: Dict[str, int] = {}
+    for f in dataclasses.fields(stacked):
+        arr = np.ascontiguousarray(np.asarray(getattr(stacked, f.name)))
+        out[f.name] = zlib.crc32(arr.tobytes())
+    return out
+
+
 class FlatLaneBackend:
     """The flat engine (`ops/flat.py`) as a serve lane backend: one
     batched ``FlatDoc`` ``[B, CAP]`` per shard, applied with the vmapped
@@ -266,7 +290,8 @@ class ContinuousBatcher:
                  counters: Optional[Counters] = None,
                  fuse_steps: bool = False, fuse_w: int = 1,
                  tracer=None, recorder=None, flow=None,
-                 pipeline_ticks: int = 1):
+                 pipeline_ticks: int = 1,
+                 sanitize_pipeline: bool = False):
         assert tuple(sorted(step_buckets)) == tuple(step_buckets)
         self.router = router
         self.residency = residency
@@ -302,6 +327,14 @@ class ContinuousBatcher:
         # by the backends' ``max_pipeline_ticks`` (1 = a barrier-time
         # true-up makes deferral unsafe — the blocked lanes backend).
         self.pipeline_ticks = max(1, pipeline_ticks)
+        # Pipeline aliasing sanitizer (ISSUE 13): when on, every
+        # dispatched tick's stacked op tensors are CRC-fingerprinted at
+        # the dispatch edge and re-checked at that entry's staged sync;
+        # any host write that raced the in-flight device step raises
+        # PipelineAliasingError naming tick/shard/array.  Detection
+        # only — it emits no trace events, so sanitized runs stay
+        # byte-identical on the logical stream.
+        self.sanitize_pipeline = sanitize_pipeline
         self._inflight: List[dict] = []
         # Per-shard stall/win not yet attributed to a trace event: a
         # deferred entry's sync may pay stall for a shard that has no
@@ -390,6 +423,7 @@ class ContinuousBatcher:
             self.residency.backends[shard].barrier()
         stall = time.perf_counter() - t0
         tok["done"] = True
+        self._check_guards(entry, shard)
         self._last_sync_end = time.perf_counter()
         self.overlap_window_s += win
         self.sync_stall_s += stall
@@ -397,6 +431,27 @@ class ContinuousBatcher:
             self._pending_stall.get(shard, 0.0) + stall)
         self._pending_win[shard] = (
             self._pending_win.get(shard, 0.0) + win)
+
+    def _check_guards(self, entry: dict, shard: int) -> None:
+        """Sanitizer re-check at the staged sync: the op tensors this
+        shard's in-flight tick dispatched must CRC-match their
+        dispatch-edge fingerprints — a mismatch means host code wrote
+        into arrays the device step may have been reading (the ISSUE-13
+        hazard class the double-buffered tick opened)."""
+        for guard in entry.get("guards", ()):
+            if guard["shard"] != shard:
+                continue
+            self.counters.incr("sanitize_checks")
+            fresh = _op_fingerprints(guard["arrays"])
+            for name, crc in guard["crcs"].items():
+                if fresh[name] != crc:
+                    raise PipelineAliasingError(
+                        f"pipeline aliasing: tick {entry['tick']} shard "
+                        f"{shard} array {name!r} changed between "
+                        f"dispatch and its staged sync (crc "
+                        f"{crc:#010x} -> {fresh[name]:#010x}) — host "
+                        f"code wrote into an op tensor an in-flight "
+                        f"device step reads")
 
     def _sync_shard_inflight(self, shard: int) -> None:
         """Complete SHARD's older in-flight device work right before a
@@ -648,6 +703,7 @@ class ContinuousBatcher:
         #    Host-only docs drain without tensor emission (nothing would
         #    consume the streams — the oracle apply is the whole serve).
         applied_events: List[Event] = []
+        tick_guards: List[dict] = []
         active_shards: set = set()
         for shard, backend in enumerate(self.residency.backends):
             t_drain = time.perf_counter()
@@ -781,6 +837,14 @@ class ContinuousBatcher:
                 t_dev = time.perf_counter()
                 backend.apply(stacked)
                 disp_ms = (time.perf_counter() - t_dev) * 1e3
+                if self.sanitize_pipeline:
+                    # Dispatch-edge fingerprint: these exact array
+                    # objects are what the in-flight device step may
+                    # still read (CPU zero-copy aliasing); the staged
+                    # sync re-checks them.
+                    tick_guards.append({
+                        "shard": shard, "arrays": stacked,
+                        "crcs": _op_fingerprints(stacked)})
                 real = sum(s.num_steps for s in lane_streams.values())
                 if fresh_shape:
                     self.counters.incr("device_compiles")
@@ -821,7 +885,8 @@ class ContinuousBatcher:
             tokens.append({"shard": shard, "token": tok, "done": False})
         self._inflight.append({"tick": tick_no, "tokens": tokens,
                                "t_dispatched": time.perf_counter(),
-                               "events": applied_events})
+                               "events": applied_events,
+                               "guards": tick_guards})
         while len(self._inflight) > depth - 1:
             self._sync_entry(self._inflight.pop(0))
         if tr is not None:

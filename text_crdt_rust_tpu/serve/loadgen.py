@@ -519,6 +519,8 @@ class ServeLoadGen:
             # the device-sync demand the staged sync hid under host
             # work, and the residual stall.
             "pipeline": {
+                "sanitize": self.cfg.sanitize_pipeline,
+                "sanitize_checks": stats.get("sanitize_checks", 0),
                 "ticks": tick_sum.get("pipeline_ticks", 1),
                 "overlap_frac": tick_sum.get("pipeline_overlap_frac",
                                              0.0),
@@ -672,6 +674,12 @@ def main(argv=None) -> None:
                          "work while the device step is in flight), "
                          "1 = the serial loop; logical streams are "
                          "byte-identical at any depth")
+    ap.add_argument("--sanitize-pipeline", action="store_true",
+                    help="pipeline aliasing sanitizer: CRC-fingerprint "
+                         "each in-flight tick's op tensors at dispatch "
+                         "and re-check at the staged sync — a host "
+                         "write racing the device step fails naming "
+                         "tick/shard/array (PERF.md §18)")
     ap.add_argument("--nagle-txns", type=int, default=d.nagle_txns,
                     help="columnar-wire Nagle window: flush a doc's "
                          "outbox once it holds this many txns")
@@ -711,6 +719,7 @@ def main(argv=None) -> None:
                       lanes_per_shard=a.lanes,
                       wire_format=a.wire, ckpt_format=a.ckpt,
                       pipeline_ticks=a.pipeline_ticks,
+                      sanitize_pipeline=a.sanitize_pipeline,
                       nagle_txns=a.nagle_txns,
                       nagle_rounds=a.nagle_rounds, lmax=a.lmax,
                       trace=not a.no_trace, trace_path=a.trace_path,

@@ -105,7 +105,8 @@ class DocServer:
                                          tracer=self.tracer,
                                          recorder=self.recorder,
                                          flow=self.flow,
-                                         pipeline_ticks=cfg.pipeline_ticks)
+                                         pipeline_ticks=cfg.pipeline_ticks,
+                                         sanitize_pipeline=cfg.sanitize_pipeline)
         self.tick_no = 0
         self._profiling = False
 

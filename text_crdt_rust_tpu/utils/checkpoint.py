@@ -49,13 +49,11 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from ..common import ROOT_ORDER
 from .rle import (
     KCRDTSpan,
     KDeleteEntry,
     KDoubleDelete,
     KOrderSpan,
-    Rle,
     TxnSpan,
 )
 

@@ -17,7 +17,7 @@ import gzip
 import json
 import os
 from dataclasses import dataclass
-from typing import List, Tuple
+from typing import List
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 DATA_DIR = os.path.join(REPO_ROOT, "benchmark_data")
