@@ -1355,6 +1355,17 @@ def cfg_serve(args):
         pipeline_ticks=(report.get("pipeline") or {}).get("ticks", 1),
         pipeline_overlap_frac=(report.get("pipeline") or {}).get(
             "overlap_frac", 0.0),
+        # ISSUE 14: device-resident prefill ride-alongs (additive
+        # fields): whether the run shipped scatter deltas instead of
+        # full-log round trips, and the per-tick byte cut.
+        device_prefill=(report.get("prefill") or {}).get(
+            "device_prefill", False),
+        prefill_bytes_per_tick=(report.get("prefill") or {}).get(
+            "bytes_per_tick", 0.0),
+        prefill_bytes_cut_x=(report.get("prefill") or {}).get(
+            "bytes_cut_x", 0.0),
+        prefill_scatter_compiles=(report.get("prefill") or {}).get(
+            "scatter_compiles", 0),
         nagle_txns=col_wire.get("nagle_txns"),
         nagle_rounds=col_wire.get("nagle_rounds"),
         wire_format=col_wire["format"],
@@ -1439,6 +1450,18 @@ def cfg_serve_lanes(args):
         pipeline_ticks=(rep.get("pipeline") or {}).get("ticks", 1),
         pipeline_overlap_frac=(rep.get("pipeline") or {}).get(
             "overlap_frac", 0.0),
+        # ISSUE 14 ride-alongs: the lanes backend's by-order tables are
+        # device-resident already (only ranks host-merge), so
+        # device_prefill reads False and the byte fields stay 0 — the
+        # additive fields keep the serve/serve-lanes rows comparable.
+        device_prefill=(rep.get("prefill") or {}).get(
+            "device_prefill", False),
+        prefill_bytes_per_tick=(rep.get("prefill") or {}).get(
+            "bytes_per_tick", 0.0),
+        prefill_bytes_cut_x=(rep.get("prefill") or {}).get(
+            "bytes_cut_x", 0.0),
+        prefill_scatter_compiles=(rep.get("prefill") or {}).get(
+            "scatter_compiles", 0),
         nagle_txns=(rep.get("wire") or {}).get("nagle_txns"),
         nagle_rounds=(rep.get("wire") or {}).get("nagle_rounds"),
         p50_admission_to_applied_us=rep["latency_us"]["p50"],

@@ -691,6 +691,12 @@ def serve_workload(smoke: bool = False, block_k: int = 0,
                 # verdict, op-age percentiles) for the flow_* row
                 # fields.
                 "flow": r.get("flow"),
+                # ISSUE 14: pipeline depth + prefill byte economy ride-
+                # alongs (the lanes backend's by-order tables are
+                # device-resident already, so its prefill block is the
+                # no-surface default; the flat twin reports the cut).
+                "pipeline": r.get("pipeline"),
+                "prefill": r.get("prefill"),
             }
             for eng, r in reports.items()
         },

@@ -228,6 +228,23 @@ def cell_serve_pair():
         # compile: steady state must cycle a fixed kernel set.
         "device_compiles": metric(srv.get("device_compiles", 0),
                                   "compile"),
+        # prefill (ISSUE 14): the device-resident log path's byte
+        # economy — scatter-delta bytes vs the full-log round trip the
+        # host path would move, the un-padded scatter volume, and the
+        # scatter program's own compile count (bounded by the
+        # geometric bucket series).  Bytes metrics live in the "wire"
+        # (bytes) family and the compile count in "compile" — the
+        # existing families cover them, so no METRIC_FAMILIES growth
+        # (and no LEDGER_SCHEMA_VERSION bump invalidating committed
+        # bench rows).
+        "prefill_bytes_per_tick": metric(
+            tick.get("prefill_bytes_per_tick", 0.0), "wire"),
+        "prefill_bytes_cut_x": metric(
+            tick.get("prefill_bytes_cut_x", 0.0), "wire"),
+        "prefill_scatter_len": metric(
+            tick.get("prefill_scatter_len", 0), "wire"),
+        "prefill_scatter_compiles": metric(
+            tick.get("prefill_scatter_compiles", 0), "compile"),
         # wire: the replication byte bill by lane.
         "wire_push_bytes": metric(wire["push_bytes"], "wire"),
         "wire_pull_bytes": metric(wire["pull_bytes"], "wire"),

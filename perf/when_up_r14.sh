@@ -1,0 +1,103 @@
+#!/bin/bash
+# Round-14 recovery watcher (ISSUE 14 / ROADMAP #1): supersedes
+# when_up_r13.sh and keeps its gate chain — matmul tunnel probe ->
+# compile pin -> fused kevin device smoke -> pipelined serve device
+# smoke (now running the DEVICE-PREFILL delta scatter by default) ->
+# sanitized pipelined smoke -> host-vs-delta prefill smoke pair ->
+# fused serve-lanes smoke (now PIPELINED depth 2) -> kevin full 5M ->
+# the remaining rows via --merge-rows -> the COST LEDGER device
+# re-record.  New in r14: the delta-prefill serve smoke runs FIRST as
+# its own gate — on a real chip async dispatch is genuinely
+# asynchronous, so this is the first run where the removed
+# dispatch-edge device read actually buys overlap (on CPU the host
+# path's np.array was a formality; on silicon it was a hidden sync) —
+# and the host-prefill arm must still converge bit-identically before
+# any re-record is trusted.  Safe to re-run; appends to
+# perf/when_up_r14.log.
+set -u
+cd /root/repo
+while true; do
+  if timeout 240 python -c "
+import jax, numpy as np, jax.numpy as jnp
+x = jnp.ones((128,128), jnp.bfloat16)
+assert float(np.asarray(x @ x)[0,0]) == 128.0
+" >/dev/null 2>&1; then
+    echo "$(date -u +%H:%M:%S) tunnel is back (r14 watcher)" >> perf/when_up_r14.log
+    break
+  fi
+  echo "$(date -u +%H:%M:%S) still down (r14)" >> perf/when_up_r14.log
+  sleep 120
+done
+timeout 2400 python perf/compile_pin.py >> perf/compile_pin_r14.log 2>&1 \
+  || echo "PIN FAILED/TIMED OUT rc=$? - investigate before trusting bench" \
+       >> perf/compile_pin_r14.log
+# Fused-kernel device smoke first: a tiny fused kevin (2048 prepends,
+# W=8) proves the W-row splice compiles on real Mosaic before
+# committing to the 40-min full run.
+timeout 1800 python bench.py --config kevin --smoke --no-probe \
+  >> perf/when_up_r14.log 2>&1 \
+  || { echo "fused kevin device smoke FAILED rc=$? - NOT re-recording" \
+         >> perf/when_up_r14.log; exit 1; }
+# DEVICE-PREFILL pipelined serve smoke (new in r14): the delta scatter
+# + double-buffered tick on real async dispatch — the first run where
+# the dispatch edge truly reads no device state.  Convergence + lane
+# bit-identity must hold before anything else is trusted.
+timeout 1800 python -m text_crdt_rust_tpu.serve.loadgen --device \
+  --docs 24 --ticks 10 --pipeline-ticks 2 \
+  >> perf/when_up_r14.log 2>&1 \
+  || { echo "device-prefill pipelined serve smoke FAILED rc=$? - NOT " \
+            "re-recording" >> perf/when_up_r14.log; exit 1; }
+# The HOST-PREFILL arm of the same seed: the two prefill paths must
+# stay byte-identical on silicon too (the ISSUE-14 contract the CPU
+# suite pins; a divergence here is a chip-side scatter bug).
+timeout 1800 python -m text_crdt_rust_tpu.serve.loadgen --device \
+  --docs 24 --ticks 10 --pipeline-ticks 2 --host-prefill \
+  >> perf/when_up_r14.log 2>&1 \
+  || { echo "host-prefill serve smoke FAILED rc=$? - NOT re-recording" \
+         >> perf/when_up_r14.log; exit 1; }
+# SANITIZED pipelined serve device smoke: the aliasing sanitizer under
+# real async dispatch.  A failure here is a REAL
+# host-write-races-device-step bug the CPU arms could never exhibit.
+timeout 1800 python -m text_crdt_rust_tpu.serve.loadgen --device \
+  --docs 24 --ticks 10 --pipeline-ticks 2 --sanitize-pipeline \
+  >> perf/when_up_r14.log 2>&1 \
+  || { echo "SANITIZED pipelined device smoke FAILED rc=$? - aliasing " \
+            "race on silicon? NOT re-recording" \
+         >> perf/when_up_r14.log; exit 1; }
+# Fused serve-lanes loadgen smoke — the blocked mixed kernel's fused
+# splice + the serve stack's fused ticks on device; since ISSUE 14 the
+# lanes backend PIPELINES at depth 2 (host-mirrored row true-up), so
+# this smoke now also exercises its staged sync on real hardware.
+timeout 1800 python -m text_crdt_rust_tpu.serve.loadgen --device \
+  --docs 24 --ticks 10 --engine rle-lanes-mixed \
+  >> perf/when_up_r14.log 2>&1 \
+  || { echo "fused serve-lanes device smoke FAILED rc=$? - NOT re-recording" \
+         >> perf/when_up_r14.log; exit 1; }
+# Headline: kevin at full 5M, fused W=64 (rle-hbm-fused row).
+timeout 7200 python bench.py --config kevin --merge-rows --no-probe \
+  >> perf/bench_kevin_r14.log 2>&1 \
+  || echo "kevin re-record FAILED rc=$?" >> perf/when_up_r14.log
+# Remaining rows, most verdict-critical first; every merged row is
+# ledger_version-stamped by the exporter.
+for cfg in northstar 4 5r 5 serve serve-lanes sp; do
+  timeout 7200 python bench.py --config "$cfg" --merge-rows --no-probe \
+    >> "perf/bench_cfg${cfg}_r14.log" 2>&1 \
+    || echo "config $cfg re-record FAILED rc=$?" >> perf/when_up_r14.log
+done
+# The cost-ledger silicon cells: device-step wall histograms +
+# real-HLO costs + the flow-device per-op provenance cell, appended to
+# the committed ledger (cpu cells untouched).
+timeout 3600 python perf/cost_ledger_probe.py --device \
+  >> perf/when_up_r14.log 2>&1 \
+  || echo "ledger device re-record FAILED rc=$?" >> perf/when_up_r14.log
+# And prove the cpu contracts still hold from this very checkout:
+# cost ledger + the tcrlint gate (a drifted tree must not re-record).
+timeout 1800 env JAX_PLATFORMS=cpu python bench.py --check-ledger \
+  >> perf/when_up_r14.log 2>&1 \
+  || echo "LEDGER CHECK FAILED rc=$? - cpu cost contract drifted" \
+       >> perf/when_up_r14.log
+timeout 600 env JAX_PLATFORMS=cpu python -m text_crdt_rust_tpu.analysis.lint \
+  >> perf/when_up_r14.log 2>&1 \
+  || echo "TCRLINT FAILED rc=$? - determinism/schema finding on this checkout" \
+       >> perf/when_up_r14.log
+echo "$(date -u +%H:%M:%S) r14 re-record done" >> perf/when_up_r14.log

@@ -1,0 +1,354 @@
+"""Device-resident by-order logs (ISSUE 14 tentpole).
+
+The flat serve backend now ships only the per-tick prefill SCATTER to
+the device (``batch.prefill_delta`` -> ``flat.apply_prefill_delta``)
+instead of round-tripping the four full [B, OCAP] logs through host
+numpy (``batch.prefill_logs``).  The contract that makes the path safe
+to ship default-on:
+
+- **bit-identity**: both paths are projections of the same
+  ``_prefill_scatter``, so every log (ol/or/rank/chars) and every
+  downstream by-order table must be byte-equal across local, remote,
+  mixed, fused (``rows_per_step`` > 1), stacked-ragged and tiled
+  streams;
+- **mode invisibility**: same-seed serve runs with device prefill on
+  and off emit byte-identical logical streams, flow censuses and
+  ledger counters, at pipeline depths 1 AND 2, under faults and a
+  forced mid-run evict->restore;
+- **zero full-log host reads** on the tick path (the O(state) cost and
+  the hidden device sync are GONE, not just cheaper);
+- **bounded compiles**: scatter lengths pad to geometric buckets, so
+  steady state cycles a fixed scatter-program set next to the fixed
+  step-bucket set.
+"""
+import random
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from text_crdt_rust_tpu.config import ServeConfig  # noqa: E402
+from text_crdt_rust_tpu.models.oracle import ListCRDT  # noqa: E402
+from text_crdt_rust_tpu.models.sync import export_txns_since  # noqa: E402
+from text_crdt_rust_tpu.ops import batch as B  # noqa: E402
+from text_crdt_rust_tpu.ops import flat as F  # noqa: E402
+from text_crdt_rust_tpu.ops import span_arrays as SA  # noqa: E402
+from text_crdt_rust_tpu.serve.loadgen import ServeLoadGen  # noqa: E402
+from text_crdt_rust_tpu.serve.server import DocServer  # noqa: E402
+from text_crdt_rust_tpu.utils.testdata import TestPatch  # noqa: E402
+
+LOGS = ("ol_log", "or_log", "rank_log", "chars_log")
+ALPHABET = "abcdefgh "
+
+
+def assert_logs_equal(host_doc, dev_doc):
+    for f in LOGS:
+        assert np.array_equal(np.asarray(getattr(host_doc, f)),
+                              np.asarray(getattr(dev_doc, f))), f
+
+
+def random_local_stream(seed: int, steps: int = 18, lmax: int = 8):
+    rng = random.Random(seed)
+    content = ""
+    patches = []
+    for _ in range(steps):
+        if not content or rng.random() < 0.65:
+            pos = rng.randint(0, len(content))
+            ins = "".join(rng.choice(ALPHABET)
+                          for _ in range(rng.randint(1, 6)))
+            patches.append(TestPatch(pos, 0, ins))
+            content = content[:pos] + ins + content[pos:]
+        else:
+            pos = rng.randint(0, len(content) - 1)
+            span = min(rng.randint(1, 3), len(content) - pos)
+            patches.append(TestPatch(pos, span, ""))
+            content = content[:pos] + content[pos + span:]
+    ops, _ = B.compile_local_patches(patches, lmax=lmax)
+    return ops
+
+
+def mixed_remote_stream(seed: int, lmax: int = 8):
+    """A remote/local MIXED compiled stream: two peers edit, their txn
+    history compiles through ``compile_remote_txns`` (remote origins +
+    remote delete target runs — the or/ol prefill subsets a pure local
+    stream never exercises)."""
+    rng = random.Random(seed)
+    peer = ListCRDT()
+    ids = [peer.get_or_create_agent_id(a) for a in ("amy", "bob")]
+    for _ in range(14):
+        a = rng.choice(ids)
+        if not len(peer) or rng.random() < 0.7:
+            peer.local_insert(a, rng.randint(0, len(peer)), "".join(
+                rng.choice(ALPHABET) for _ in range(rng.randint(1, 5))))
+        else:
+            pos = rng.randint(0, len(peer) - 1)
+            peer.local_delete(a, pos, min(rng.randint(1, 3),
+                                          len(peer) - pos))
+    txns = export_txns_since(peer, 0)
+    table = B.AgentTable(["amy", "bob"])
+    ops, _ = B.compile_remote_txns(txns, table, lmax=lmax)
+    return ops
+
+
+def fused_burst_stream(lmax: int = 8, w: int = 4):
+    """The kevin prepend shape compiled with fuse_w > 1: W-row fused
+    steps whose prefill chain breaks at every sub-run head."""
+    patches = [TestPatch(0, 0, "xy") for _ in range(10)]
+    ops, _ = B.compile_local_patches(patches, lmax=lmax, fuse_w=w)
+    assert B.fused_width(ops) > 1
+    return ops
+
+
+def _both_paths(doc, ops):
+    host = B.prefill_logs(doc, ops)
+    dev = F.apply_prefill_delta(doc, B.prefill_delta(ops))
+    return host, dev
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_local_stream_delta_equals_host_prefill(seed):
+    ops = random_local_stream(seed)
+    doc = SA.make_flat_doc(256, 512)
+    assert_logs_equal(*_both_paths(doc, ops))
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_mixed_remote_stream_delta_equals_host_prefill(seed):
+    ops = mixed_remote_stream(seed)
+    doc = SA.make_flat_doc(256, 512)
+    assert_logs_equal(*_both_paths(doc, ops))
+
+
+def test_fused_stream_delta_equals_host_prefill():
+    ops = fused_burst_stream()
+    doc = SA.make_flat_doc(256, 512)
+    assert_logs_equal(*_both_paths(doc, ops))
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_stacked_ragged_batch_delta_equals_host_prefill(seed):
+    """The serve shape: ragged per-lane streams stacked [S, B] onto a
+    batched doc — per-lane scatters, lane-local buckets."""
+    import jax.numpy as jnp
+
+    streams = [random_local_stream(seed * 10 + k, steps=4 + 3 * k)
+               for k in range(3)] + [B.empty_ops(8)]
+    stacked = B.stack_ops(streams)
+    docs = jax.tree.map(jnp.array,
+                        SA.stack_docs(SA.make_flat_doc(256, 512), 4))
+    assert_logs_equal(*_both_paths(docs, stacked))
+
+
+def test_tiled_batch_broadcast_delta_equals_host_prefill():
+    """One stream tiled across B docs: the unbatched-delta broadcast
+    path (config-2 shape)."""
+    import jax.numpy as jnp
+
+    ops = random_local_stream(3)
+    docs = jax.tree.map(jnp.array,
+                        SA.stack_docs(SA.make_flat_doc(256, 512), 3))
+    host = B.prefill_logs(docs, B.tile_ops(ops, 3))
+    dev = F.apply_prefill_delta(docs, B.prefill_delta(ops))
+    assert_logs_equal(host, dev)
+
+
+def test_full_apply_through_delta_matches_oracle_tables():
+    """End to end through the step scan: delta-prefill + apply equals
+    host-prefill + apply on the whole doc (signed body, by-order
+    tables, string)."""
+    ops = mixed_remote_stream(9)
+    doc = SA.make_flat_doc(256, 512)
+    via_host = F.apply_ops(doc, ops)  # prefill=True: the host path
+    via_delta = F._apply_ops(
+        F.apply_prefill_delta(doc, B.prefill_delta(ops)), ops,
+        local_only=False)
+    assert np.array_equal(np.asarray(via_host.signed),
+                          np.asarray(via_delta.signed))
+    assert_logs_equal(via_host, via_delta)
+    assert SA.to_string(via_host) == SA.to_string(via_delta)
+    assert SA.doc_spans(via_host) == SA.doc_spans(via_delta)
+
+
+def test_empty_and_delete_only_streams_skip_the_scatter():
+    """A stream with no inserts writes no log values: prefill_delta is
+    None (no scatter program compiled) and the no-op passthrough leaves
+    the doc untouched."""
+    doc = SA.make_flat_doc(64, 128)
+    assert B.prefill_delta(B.empty_ops(4)) is None
+    ops, _ = B.compile_local_patches([TestPatch(0, 0, "abc")], lmax=4)
+    doc2 = F.apply_ops(doc, ops)
+    del_ops, _ = B.compile_local_patches([TestPatch(0, 2, "")], lmax=4,
+                                         start_order=3)
+    assert B.prefill_delta(del_ops) is None
+    assert F.apply_prefill_delta(doc2, None) is doc2
+
+
+def test_scatter_bucket_series_is_geometric_and_bounded():
+    assert B.scatter_bucket(0) == B.PREFILL_BUCKET_BASE
+    assert B.scatter_bucket(32) == 32
+    assert B.scatter_bucket(33) == 128
+    assert B.scatter_bucket(2048) == 2048
+    # Any serve tick (S <= 128 steps x lmax 16) sees at most 4 buckets.
+    buckets = {B.scatter_bucket(n) for n in range(0, 128 * 16 + 1, 7)}
+    assert len(buckets) <= 4, buckets
+
+
+# -- serve-level contracts ----------------------------------------------------
+
+
+def _serve_run(device_prefill: bool, pipeline_ticks: int):
+    cfg = ServeConfig(engine="flat", num_shards=2, lanes_per_shard=4,
+                      device_prefill=device_prefill,
+                      pipeline_ticks=pipeline_ticks, trace_keep=True,
+                      flow_sample_mod=1)
+    gen = ServeLoadGen(docs=8, agents_per_doc=2, ticks=8,
+                       events_per_tick=12, fault_rate=0.10, seed=7,
+                       cfg=cfg)
+    rep = gen.run()
+    assert rep["converged"], rep["mismatches"][:4]
+    return rep, gen.server.tracer.logical_bytes()
+
+
+def test_serve_delta_vs_host_prefill_byte_identical_both_depths():
+    """The ISSUE-14 acceptance: same-seed logical streams, flow audits
+    and ledger counters byte-identical delta-vs-host prefill at
+    pipeline depths 1 and 2, under 10% faults.  Only the prefill byte
+    economy itself may differ."""
+    runs = {(dp, pt): _serve_run(dp, pt)
+            for dp in (True, False) for pt in (1, 2)}
+    traces = {k: t for k, (_, t) in runs.items()}
+    assert len(set(traces.values())) == 1, \
+        "logical streams must not know the prefill mode or depth"
+    reps = {k: r for k, (r, _) in runs.items()}
+    ref = reps[(True, 2)]
+    for key, rep in reps.items():
+        assert rep["flow"]["audit_ok"], rep["flow"]["findings"][:4]
+        assert rep["flow"]["spans"] == ref["flow"]["spans"], key
+        assert rep["flow"]["ages_ticks"] == ref["flow"]["ages_ticks"]
+        for counter in ("device_ticks", "device_steps", "device_compiles",
+                        "evictions", "restores", "admitted"):
+            assert rep["server"].get(counter) == ref["server"].get(
+                counter), (key, counter)
+        assert rep["wire"] == ref["wire"], key
+    # The byte economy is the only divergence: the delta path moves
+    # >= 20x less than the full-log round trip and compiles a bounded
+    # scatter set; the host path moves the full logs and compiles none.
+    assert ref["prefill"]["device_prefill"]
+    assert ref["prefill"]["bytes_cut_x"] >= 20.0, ref["prefill"]
+    assert 1 <= ref["prefill"]["scatter_compiles"] <= 12
+    host = reps[(False, 2)]["prefill"]
+    assert not host["device_prefill"]
+    assert host["bytes_cut_x"] == 1.0
+    assert host["scatter_compiles"] == 0
+
+
+def test_forced_evict_restore_mode_equivalence(tmp_path):
+    """Delta-vs-host equivalence across a FORCED mid-run evict->restore
+    (the host-mirror reset path: upload_lane must reseed the mirrored
+    n/next_order exactly or the capacity check diverges later)."""
+    outs = {}
+    for dp in (True, False):
+        cfg = ServeConfig(engine="flat", num_shards=1, lanes_per_shard=2,
+                          device_prefill=dp, pipeline_ticks=2,
+                          trace_keep=True, flow_sample_mod=1,
+                          spool_dir=str(tmp_path / f"dp{dp}"))
+        server = DocServer(cfg)
+        for d in range(3):
+            server.admit_doc(f"doc{d}")
+        for i in range(4):
+            for d in range(3):
+                server.submit_local(f"doc{d}", "alice", pos=0,
+                                    ins_content=f"t{i}d{d}x")
+            server.tick()
+        doc0 = server.doc_state("doc0")
+        if doc0.resident:
+            server.residency.evict(doc0)
+        for i in range(3):
+            for d in range(3):
+                server.submit_local(f"doc{d}", "alice", pos=0,
+                                    ins_content=f"u{i}d{d}y")
+            server.tick()
+        server.drain()
+        assert all(server.verify_doc(f"doc{d}") for d in range(3))
+        outs[dp] = ([server.doc_string(f"doc{d}") for d in range(3)],
+                    server.tracer.logical_bytes(),
+                    server.flow_summary(expect_terminal=True)["spans"])
+        server.close_obs()
+    assert outs[True] == outs[False]
+
+
+def test_no_full_log_host_materialization_on_tick_path(monkeypatch):
+    """With device_prefill on (the shipped default), the serve tick
+    performs ZERO full-log host materializations: ``prefill_logs`` is
+    never reached (this guards the acceptance criterion directly — a
+    regression re-introducing the round trip trips the sentinel)."""
+    def boom(*a, **kw):
+        raise AssertionError(
+            "batch.prefill_logs reached from the serve tick path with "
+            "device_prefill on — the full-log host round trip is back")
+
+    monkeypatch.setattr(B, "prefill_logs", boom)
+    server = DocServer(ServeConfig(engine="flat", num_shards=1,
+                                   lanes_per_shard=2))
+    server.admit_doc("d")
+    for i in range(3):
+        server.submit_local("d", "a", pos=0, ins_content=f"hi{i}")
+        server.tick()
+    server.drain()
+    assert server.verify_doc("d")
+    assert server.doc_string("d").startswith("hi2")
+    server.close_obs()
+
+
+def test_scatter_recompile_guard_steady_state_bounded():
+    """Varying per-tick insert volumes must not grow the compiled
+    scatter set past the geometric bucket count: shapes_seen stays
+    inside the step buckets AND scatter_shapes_seen inside the
+    scatter-bucket series (the (S, scatter_bucket) steady-state
+    contract)."""
+    server = DocServer(ServeConfig(engine="flat", num_shards=1,
+                                   lanes_per_shard=2,
+                                   step_buckets=(8, 32),
+                                   max_txn_len=32))
+    server.admit_doc("d")
+    rng = np.random.RandomState(0)
+    for _ in range(14):
+        for _ in range(int(rng.randint(1, 5))):
+            n = int(rng.randint(1, 12))
+            server.submit_local("d", "ed", 0, ins_content="x" * n)
+        server.tick()
+    backend = server.residency.backends[0]
+    assert backend.shapes_seen <= {8, 32}, backend.shapes_seen
+    legal = {B.PREFILL_BUCKET_BASE * 4 ** k for k in range(4)}
+    assert backend.scatter_shapes_seen <= legal, \
+        backend.scatter_shapes_seen
+    assert len(backend.scatter_shapes_seen) <= 3
+    assert server.verify_doc("d")
+    server.close_obs()
+
+
+def test_host_mirrored_capacity_check_matches_device_counts():
+    """The device path's capacity check reads HOST mirrors, never the
+    device: after ticks, evict->restore and clears, the mirrors must
+    equal the device's n/next_order exactly."""
+    server = DocServer(ServeConfig(engine="flat", num_shards=1,
+                                   lanes_per_shard=2))
+    server.admit_doc("d")
+    for i in range(4):
+        server.submit_local("d", "a", pos=0, ins_content=f"w{i}")
+        server.tick()
+    doc = server.doc_state("d")
+    server.residency.evict(doc)
+    server.submit_local("d", "a", pos=0, ins_content="back")
+    server.tick()
+    server.drain()
+    backend = server.residency.backends[0]
+    assert np.array_equal(backend._n_host,
+                          np.asarray(backend.docs.n, dtype=np.int64))
+    assert np.array_equal(
+        backend._next_order_host,
+        np.asarray(backend.docs.next_order, dtype=np.int64))
+    server.close_obs()

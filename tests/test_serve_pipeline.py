@@ -30,15 +30,23 @@ LOGICAL_SRV_KEYS = ("device_ticks", "device_steps", "evictions",
                     "restores", "admitted", "ckpt_bytes_written")
 
 
-def _loadgen_run(pipeline_ticks: int):
+_LANES_CFG = dict(engine="rle-lanes-mixed", lane_capacity=128,
+                  lanes_block_k=8, order_capacity=512,
+                  step_buckets=(8, 32), max_txn_len=32)
+
+
+def _loadgen_run(pipeline_ticks: int, engine: str = "flat",
+                 docs: int = 8, ticks: int = 10):
     # sanitize_pipeline rides the PIPELINED arm (ISSUE 13: left on in
     # the serve tests): the byte-identity assert below then doubles as
     # the sanitized-vs-unsanitized logical-invisibility proof.
-    cfg = ServeConfig(engine="flat", num_shards=2, lanes_per_shard=4,
+    kw = dict(_LANES_CFG) if engine == "rle-lanes-mixed" else \
+        dict(engine="flat")
+    cfg = ServeConfig(num_shards=2, lanes_per_shard=4,
                       pipeline_ticks=pipeline_ticks, trace_keep=True,
                       sanitize_pipeline=pipeline_ticks > 1,
-                      flow_sample_mod=1)
-    gen = ServeLoadGen(docs=8, agents_per_doc=2, ticks=10,
+                      flow_sample_mod=1, **kw)
+    gen = ServeLoadGen(docs=docs, agents_per_doc=2, ticks=ticks,
                        events_per_tick=12, fault_rate=0.10, seed=7,
                        cfg=cfg)
     rep = gen.run()
@@ -70,14 +78,16 @@ def test_pipelined_vs_serial_byte_identical_under_faults():
     assert rep_p["pipeline"]["ticks"] == 2
 
 
-def _direct_server_run(pipeline_ticks: int):
+def _direct_server_run(pipeline_ticks: int, engine: str = "flat"):
     """Deterministic direct-server drive with a FORCED mid-run
     evict->restore while the pipeline holds an in-flight tick — the
     checkpoint boundary a deferred sync must not smear state across."""
-    cfg = ServeConfig(engine="flat", num_shards=1, lanes_per_shard=2,
+    kw = dict(_LANES_CFG) if engine == "rle-lanes-mixed" else \
+        dict(engine="flat")
+    cfg = ServeConfig(num_shards=1, lanes_per_shard=2,
                       pipeline_ticks=pipeline_ticks, trace_keep=True,
                       sanitize_pipeline=pipeline_ticks > 1,
-                      flow_sample_mod=1)
+                      flow_sample_mod=1, **kw)
     server = DocServer(cfg)
     for d in range(3):
         server.admit_doc(f"doc{d}")
@@ -137,16 +147,59 @@ def test_overlap_accounting_and_flush():
     assert serial.tick_summary()["pipeline_overlap_frac"] == 0.0
 
 
-def test_lanes_backend_clamps_to_serial():
-    """A backend whose barrier trues up probe state must not be
-    deferred: the blocked lanes backend caps the effective depth at 1
-    no matter what the config asks for."""
-    cfg = ServeConfig(engine="rle-lanes-mixed", num_shards=1,
-                      lanes_per_shard=2, pipeline_ticks=4)
+def test_lanes_backend_opts_into_depth_two():
+    """ISSUE 14 (ROADMAP 7a): the blocked lanes backend's run-row
+    true-up moved to a host-mirrored fixed-schedule model, so its
+    barrier no longer feeds the capacity probes and it opts into depth
+    2 — capped THERE, not at the config's deeper ask (the dispatch-edge
+    sync is what guarantees its lagged true-up reads stay cheap)."""
+    cfg = ServeConfig(num_shards=1, lanes_per_shard=2,
+                      pipeline_ticks=4, **_LANES_CFG)
     server = DocServer(cfg)
     assert server.batcher.pipeline_ticks == 4
-    assert server.batcher.effective_pipeline_ticks() == 1
+    assert server.batcher.effective_pipeline_ticks() == 2
     server.close_obs()
+
+
+def test_lanes_pipelined_depth2_byte_identical_under_faults():
+    """The ISSUE-14 acceptance arm: the LANES backend at depth 2 vs
+    depth 1 under 10% faults — logical streams, flow census and the
+    ledger-gated counters all byte-identical (the fixed-schedule row
+    true-up is depth-invariant by construction; this pins it)."""
+    rep_p, trace_p = _loadgen_run(2, engine="rle-lanes-mixed", docs=6,
+                                  ticks=8)
+    rep_s, trace_s = _loadgen_run(1, engine="rle-lanes-mixed", docs=6,
+                                  ticks=8)
+    assert rep_s["converged"] and rep_p["converged"]
+    assert trace_s == trace_p, "lanes logical streams must be depth-invariant"
+    assert rep_s["flow"]["spans"] == rep_p["flow"]["spans"]
+    assert rep_s["flow"]["ages_ticks"] == rep_p["flow"]["ages_ticks"]
+    for key in LOGICAL_KEYS:
+        assert rep_s[key] == rep_p[key], key
+    for key in LOGICAL_SRV_KEYS:
+        assert rep_s["server"].get(key) == rep_p["server"].get(key), key
+    assert rep_s["pipeline"]["ticks"] == 1
+    assert rep_p["pipeline"]["ticks"] == 2
+    assert rep_p["pipeline"]["overlap_frac"] > 0.0
+
+
+def test_lanes_mid_run_evict_restore_depth_equivalence():
+    """The lanes backend's depth-2 evict->restore boundary: a forced
+    mid-run evict while a tick may be in flight, then a restore (the
+    per-lane blocked reseed) — strings, traces and flow census
+    identical to the serial run (the residency-fresh mask keeps the
+    lagged true-up from resurrecting pre-upload row counts)."""
+    strings_p, flow_p, trace_p, srv_p = _direct_server_run(
+        2, engine="rle-lanes-mixed")
+    strings_s, flow_s, trace_s, srv_s = _direct_server_run(
+        1, engine="rle-lanes-mixed")
+    assert strings_s == strings_p
+    assert trace_s == trace_p
+    assert flow_s["audit_ok"] and flow_p["audit_ok"]
+    assert flow_s["spans"] == flow_p["spans"]
+    ev = srv_s.counters.summary().get("evictions")
+    assert ev == srv_p.counters.summary().get("evictions")
+    assert ev >= 1
 
 
 def test_depth_one_is_exactly_the_serial_loop():

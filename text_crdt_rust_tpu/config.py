@@ -205,6 +205,27 @@ class ServeConfig:
     #                            equal wall, 32 -> 1856 but +25% CPU
     #                            wall from the 4x-wider char columns —
     #                            16 is the shipped winner (PERF.md §17)
+    device_prefill: bool = True  # device-resident by-order logs
+    #                            (ISSUE 14): the flat backend ships ONLY
+    #                            the per-tick prefill scatter as fixed-
+    #                            shape padded delta tensors and applies
+    #                            it on device (`ops.flat.
+    #                            apply_prefill_delta`), instead of
+    #                            round-tripping the four full [B, OCAP]
+    #                            logs through host numpy every tick
+    #                            (`batch.prefill_logs`) — the serve
+    #                            tick's last O(state) host cost becomes
+    #                            O(ops), and the dispatch edge stops
+    #                            reading device state (the hidden sync
+    #                            that ate the pipelined overlap under
+    #                            real async dispatch).  Logical streams
+    #                            and ledger counters are byte-identical
+    #                            either way (tests/test_device_prefill
+    #                            .py); False = the PR-3 host path
+    #                            (loadgen --host-prefill).  Backends
+    #                            without device-resident logs (the
+    #                            blocked lanes backend prefills only
+    #                            ranks, host-side) accept and ignore it
     pipeline_ticks: int = 2    # host/device tick pipelining depth
     #                            (ISSUE 12): 2 = double-buffered — tick
     #                            N+1's drain/fuse/oracle-apply/compile

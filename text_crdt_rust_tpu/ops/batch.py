@@ -1017,6 +1017,134 @@ def prefill_logs(doc, ops: OpTensors):
         rank_log=jnp.asarray(rank), chars_log=jnp.asarray(chars))
 
 
+# -- device-resident prefill (ISSUE 14) --------------------------------------
+# The serve tick used to round-trip the four FULL [B, OCAP] by-order
+# logs through host numpy every tick (``prefill_logs`` materializes,
+# scatters a few hundred compile-time-known values, re-uploads) — an
+# O(state) host cost on an O(ops) edit, and a hidden device sync on the
+# previous tick's output that eats the pipelined overlap under real
+# async dispatch.  ``prefill_delta`` ships ONLY the scatter — fixed-
+# shape padded (positions, values) tensors — and ``ops.flat.
+# apply_prefill_delta`` applies it on device (``.at[pos].set(...,
+# mode="drop")``), so the logs stay device-resident for the life of a
+# lane.  Scatter lengths are padded to a small geometric bucket set
+# (``scatter_bucket``) so steady-state serving compiles one scatter
+# program per bucket, exactly the step-bucket discipline of the tick.
+
+#: Padding position for scatter tensors: positive, out of range for any
+#: real order capacity (< 2^31), so ``mode="drop"`` discards it.  The
+#: value columns pad with 0 (never read — the position is dropped).
+PREFILL_PAD = np.uint32(0x7FFFFFFF)
+
+#: Smallest scatter bucket; buckets grow geometrically (x4) from here,
+#: so a serve shape sees at most ~4-5 distinct scatter programs no
+#: matter how ragged the per-tick insert volume is.
+PREFILL_BUCKET_BASE = 32
+
+
+def scatter_bucket(n: int) -> int:
+    """Smallest bucket (PREFILL_BUCKET_BASE * 4^k) holding ``n`` scatter
+    entries — the fixed-shape pad target that keeps the jitted device
+    scatter's compile cache bounded (geometric growth: any workload sees
+    O(log n) distinct shapes, and a serve tick's scatter is capped at
+    S_bucket * lmax entries anyway)."""
+    b = PREFILL_BUCKET_BASE
+    while b < n:
+        b *= 4
+    return b
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["ins_pos", "chars_val", "rank_val", "ol_pos", "ol_val",
+                 "or_pos", "or_val"],
+    meta_fields=["bucket"],
+)
+@dataclasses.dataclass
+class PrefillDelta:
+    """The compile-time-known by-order log writes of an op stream as
+    fixed-shape padded scatter tensors (``[L]`` for one stream,
+    ``[B, L]`` for a stacked batch; ``L = bucket``).
+
+    ``chars_log`` and ``rank_log`` share one position column (every
+    inserted char gets both); ``ol``/``or`` carry their own (the chain
+    subset / the remote subset).  Padding rows hold ``PREFILL_PAD``
+    positions, dropped by the device scatter's ``mode="drop"``.
+    ``bucket`` is static metadata (part of the jit cache key) — it is
+    drawn from ``scatter_bucket``'s geometric series, so the compiled
+    scatter set stays bounded."""
+
+    ins_pos: jax.Array    # u32[..., L] chars/rank write positions
+    chars_val: jax.Array  # u32[..., L]
+    rank_val: jax.Array   # u32[..., L]
+    ol_pos: jax.Array     # u32[..., L] origin_left writes (chain + heads)
+    ol_val: jax.Array     # u32[..., L]
+    or_pos: jax.Array     # u32[..., L] origin_right writes (remote runs)
+    or_val: jax.Array     # u32[..., L]
+    bucket: int
+
+    def nbytes(self) -> int:
+        """Bytes this delta moves host->device (the whole cost of a
+        device-resident prefill; compare 2 * 4 * OCAP * B * 4 for the
+        full-log round trip)."""
+        return sum(np.asarray(getattr(self, f)).nbytes for f in
+                   ("ins_pos", "chars_val", "rank_val", "ol_pos",
+                    "ol_val", "or_pos", "or_val"))
+
+
+def _delta_rows(sc, L: int):
+    """One lane's scatter dict -> seven padded length-L u32 rows."""
+    ins_pos = np.full(L, PREFILL_PAD, np.uint32)
+    chars_val = np.zeros(L, np.uint32)
+    rank_val = np.zeros(L, np.uint32)
+    ol_pos = np.full(L, PREFILL_PAD, np.uint32)
+    ol_val = np.zeros(L, np.uint32)
+    or_pos = np.full(L, PREFILL_PAD, np.uint32)
+    or_val = np.zeros(L, np.uint32)
+    if sc is not None:
+        p, v = sc["chars"]
+        ins_pos[:len(p)] = p
+        chars_val[:len(p)] = v
+        rank_val[:len(p)] = sc["rank"][1]
+        p, v = sc["ol"]
+        ol_pos[:len(p)] = p
+        ol_val[:len(p)] = v
+        p, v = sc["or"]
+        or_pos[:len(p)] = p
+        or_val[:len(p)] = v
+    return ins_pos, chars_val, rank_val, ol_pos, ol_val, or_pos, or_val
+
+
+def prefill_delta(ops: OpTensors) -> Optional[PrefillDelta]:
+    """``_prefill_scatter`` as fixed-shape padded device tensors: the
+    delta-prefill twin of ``prefill_logs`` (ISSUE 14).  ``ops`` may be
+    unbatched ``[S, ...]`` or batched ``[S, B, ...]`` (one scatter row
+    per lane).  Returns ``None`` when the stream inserts nothing (a
+    pure-delete or all-padding tick writes no log values, and skipping
+    the scatter call entirely keeps the compile set minimal) — callers
+    skip the device scatter in that case.
+
+    Correctness contract (pinned by ``tests/test_device_prefill.py``):
+    applying the delta on device (``ops.flat.apply_prefill_delta``) is
+    bit-identical to ``prefill_logs`` on every log, for local, remote,
+    mixed, fused (``rows_per_step`` > 1) and tiled streams — both paths
+    are projections of the SAME ``_prefill_scatter``."""
+    batched = np.asarray(ops.kind).ndim == 2
+    if not batched:
+        scs = [_prefill_scatter(ops)]
+    else:
+        host = jax.tree.map(np.asarray, ops)
+        scs = [_prefill_scatter(jax.tree.map(lambda a: a[:, b], host))
+               for b in range(np.asarray(ops.kind).shape[1])]
+    if all(sc is None for sc in scs):
+        return None
+    need = max(len(sc["chars"][0]) for sc in scs if sc is not None)
+    L = scatter_bucket(need)
+    cols = [np.stack(rows) if batched else rows[0]
+            for rows in zip(*(_delta_rows(sc, L) for sc in scs))]
+    return PrefillDelta(*cols, bucket=L)
+
+
 def row_growth_bound(num_steps: int) -> int:
     """Sound per-lane run-row bound after ``num_steps`` compiled device
     steps: every step splices at most 2 new rows (insert splice / delete

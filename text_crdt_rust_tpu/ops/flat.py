@@ -25,14 +25,29 @@ one element/cycle and dominated the first version of this engine):
   inserts exist, `doc.rs:192-194`).
 
 Immutable per-item metadata (origins, ranks, chars) lives in by-order logs
-mostly prefilled host-side by the op compiler (``batch.prefill_logs``); a
-local-insert step writes only the two origins it discovers at apply time.
+prefilled with everything the op compiler already knows, by either of two
+equivalent paths (bit-identical, pinned by ``tests/test_device_prefill.py``):
+
+- **host prefill** (``batch.prefill_logs``): materialize the logs host-side,
+  scatter with numpy, re-upload — the build-time path the replay engines
+  (``ops.rle``/``ops.blocked``/``parallel.mesh``) use, where the doc is
+  being constructed on host anyway;
+- **device-resident delta prefill** (``batch.prefill_delta`` +
+  ``apply_prefill_delta``, ISSUE 14): ship only the fixed-shape padded
+  (positions, values) scatter and apply it on device ahead of the step
+  scan — the serve tick's path (``ServeConfig.device_prefill``), where the
+  logs live on device across ticks and a full-log round trip would cost
+  O(state) per O(ops) tick (and a hidden host sync under async dispatch).
+
+A local-insert step then writes only the two origins it discovers at apply
+time.
 
 Frontier/time-DAG bookkeeping stays host-side (``models.oracle`` /
 ``parallel.causal``), per SURVEY §7 "keep on host".
 """
 from __future__ import annotations
 
+import dataclasses
 from functools import partial
 
 import jax
@@ -242,28 +257,113 @@ def step(doc: FlatDoc, op, local_only: bool = False) -> FlatDoc:
     )
 
 
-def _check_capacity(doc: FlatDoc, ops: OpTensors) -> None:
-    """Host-side overflow guard: the splice wraps around silently on
-    device, so exceeding the static capacities would corrupt, not crash.
+def check_capacity_counts(n, next_order, capacity: int,
+                          order_capacity: int, ops: OpTensors) -> None:
+    """The ONE capacity contract for a flat-doc op stream, against
+    caller-supplied occupancy counts (``n``/``next_order`` may be the
+    device doc's arrays or the serve backend's host mirrors — the
+    bounds must never drift between those two callers).
 
     The bound is per-document: with a batched doc and per-lane streams
-    (the serve batcher's shape) each lane's own occupancy pairs with its
-    own stream's growth — a full lane with no traffic must not fail the
-    check on behalf of an empty lane with a long stream."""
+    (the serve batcher's shape) each lane's own occupancy pairs with
+    its own stream's growth — a full lane with no traffic must not
+    fail the check on behalf of an empty lane with a long stream."""
     require_unfused(ops, "the flat engine")
-    need = np.asarray(doc.n) + np.asarray(ops.ins_len).sum(axis=0)
-    assert int(np.max(need)) <= doc.capacity, (
+    need = (np.asarray(n, dtype=np.int64)
+            + np.asarray(ops.ins_len, dtype=np.int64).sum(axis=0))
+    assert int(np.max(need)) <= capacity, (
         f"op stream needs {int(np.max(need))} rows but capacity is "
-        f"{doc.capacity}; allocate a larger FlatDoc"
+        f"{capacity}; allocate a larger FlatDoc"
     )
-    o_need = (np.asarray(doc.next_order)
-              + np.asarray(ops.order_advance).sum(axis=0))
+    o_need = (np.asarray(next_order, dtype=np.int64)
+              + np.asarray(ops.order_advance, dtype=np.int64).sum(axis=0))
     # lmax slots of headroom: the log-write window is a static lmax-wide
     # slice whose clipped start must never shift a real write.
-    assert int(np.max(o_need)) <= doc.order_capacity - ops.lmax, (
+    assert int(np.max(o_need)) <= order_capacity - ops.lmax, (
         f"op stream needs {int(np.max(o_need))}+{ops.lmax} orders but "
-        f"order capacity is {doc.order_capacity}; allocate a larger FlatDoc"
+        f"order capacity is {order_capacity}; allocate a larger FlatDoc"
     )
+
+
+def _check_capacity(doc: FlatDoc, ops: OpTensors) -> None:
+    """Host-side overflow guard: the splice wraps around silently on
+    device, so exceeding the static capacities would corrupt, not
+    crash.  Reads the doc's device counts; the serve backend's
+    device-prefill path runs the same contract against its host
+    mirrors (``check_capacity_counts``)."""
+    check_capacity_counts(doc.n, doc.next_order, doc.capacity,
+                          doc.order_capacity, ops)
+
+
+# -- device-resident prefill (ISSUE 14) ---------------------------------------
+# The by-order log writes the compiler knows at compile time, applied ON
+# DEVICE from the fixed-shape padded scatter ``batch.prefill_delta``
+# builds — the serve tick's alternative to round-tripping the full
+# [B, OCAP] logs through host numpy (``batch.prefill_logs``).  Padding
+# positions are out of range (``batch.PREFILL_PAD``) and dropped by
+# ``mode="drop"``; real positions are unique within one stream (orders
+# are allocated uniquely), so the scatter is order-independent.  All
+# three variants are module-level jits (the tcrlint TCR-R002 contract):
+# the compile cache is keyed by (OCAP, bucket[, B]) only — the scatter
+# program is independent of the tick's step bucket, so the serve
+# steady-state compile set is |step buckets| + |scatter buckets|, not
+# their product.
+
+
+def _scatter_cols(ol, orr, rank, chars, ip, cv, rv, olp, olv, orp, orv):
+    """Scatter one lane's seven delta rows into its four log columns."""
+    chars = chars.at[ip].set(cv, mode="drop")
+    rank = rank.at[ip].set(rv, mode="drop")
+    ol = ol.at[olp].set(olv, mode="drop")
+    orr = orr.at[orp].set(orv, mode="drop")
+    return ol, orr, rank, chars
+
+
+def _delta_cols(d):
+    return (d.ins_pos, d.chars_val, d.rank_val, d.ol_pos, d.ol_val,
+            d.or_pos, d.or_val)
+
+
+@jax.jit
+def _scatter_delta(doc, d):
+    """Unbatched doc + unbatched delta, or batched doc + unbatched
+    delta (the tiled-stream broadcast: the trailing-axis fancy index
+    broadcasts over the doc axis, like ``batch._apply_scatter``)."""
+    ol = doc.ol_log.at[..., d.ol_pos].set(d.ol_val, mode="drop")
+    orr = doc.or_log.at[..., d.or_pos].set(d.or_val, mode="drop")
+    rank = doc.rank_log.at[..., d.ins_pos].set(d.rank_val, mode="drop")
+    chars = doc.chars_log.at[..., d.ins_pos].set(d.chars_val,
+                                                 mode="drop")
+    return dataclasses.replace(doc, ol_log=ol, or_log=orr,
+                               rank_log=rank, chars_log=chars)
+
+
+@jax.jit
+def _scatter_delta_batch(docs, d):
+    """Batched docs [B, OCAP] + batched delta [B, L]: one per-lane
+    scatter under vmap."""
+    ol, orr, rank, chars = jax.vmap(_scatter_cols)(
+        docs.ol_log, docs.or_log, docs.rank_log, docs.chars_log,
+        *_delta_cols(d))
+    return dataclasses.replace(docs, ol_log=ol, or_log=orr,
+                               rank_log=rank, chars_log=chars)
+
+
+def apply_prefill_delta(doc: FlatDoc, delta) -> FlatDoc:
+    """Apply a ``batch.PrefillDelta`` to the by-order logs on device —
+    the device-resident twin of ``batch.prefill_logs`` (bit-identical
+    logs, no host materialization).  Accepts every doc/delta batching
+    combination ``prefill_logs`` does: unbatched/unbatched, batched
+    docs + unbatched delta (tiled broadcast), batched/batched.  Pass
+    ``None`` deltas through (a no-insert stream writes nothing)."""
+    if delta is None:
+        return doc
+    doc_b = doc.ol_log.ndim == 2
+    delta_b = np.asarray(delta.ins_pos).ndim == 2
+    if delta_b:
+        assert doc_b, "batched delta needs a batched doc"
+        return _scatter_delta_batch(doc, delta)
+    return _scatter_delta(doc, delta)
 
 
 @partial(jax.jit, static_argnames=("local_only",))
@@ -295,8 +395,21 @@ def _is_local_only(ops: OpTensors) -> bool:
 def apply_ops(doc: FlatDoc, ops: OpTensors, prefill: bool = True) -> FlatDoc:
     """Apply a compiled step stream to one document (``lax.scan``).
 
-    ``prefill`` runs ``batch.prefill_logs`` first (host-side); pass False
-    when the doc's logs were already prefilled (e.g. re-running a stream).
+    The by-order logs must be prefilled for this stream before the scan
+    runs, by either of the two bit-identical paths (module header):
+
+    - ``prefill=True`` (default) runs the HOST path, ``batch.
+      prefill_logs`` — what the build-time replay engines (``ops.rle``/
+      ``ops.blocked``/``parallel.mesh``) and one-shot callers use;
+    - ``prefill=False`` + caller-managed prefill: either the logs were
+      already host-prefilled for this stream (e.g. re-running it), or
+      the caller applied the DEVICE path first — ``apply_prefill_delta
+      (doc, batch.prefill_delta(ops))``, the serve tick's
+      device-resident route (``ServeConfig.device_prefill``; see
+      ``serve.batcher.FlatLaneBackend.apply``).
+
+    Applying an un-prefilled stream gives silently wrong results (NUL
+    chars, wrong tiebreak ranks), not a crash.
     """
     from .batch import prefill_logs
 

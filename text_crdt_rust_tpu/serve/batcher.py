@@ -104,7 +104,8 @@ class FlatLaneBackend:
 
     def __init__(self, lanes: int, capacity: int, order_capacity: int,
                  lmax: int, block_k: Optional[int] = None,
-                 interpret: Optional[bool] = None, fuse_w: int = 1):
+                 interpret: Optional[bool] = None, fuse_w: int = 1,
+                 device_prefill: bool = True):
         import jax.numpy as jnp
 
         # block_k / interpret / fuse_w are lane-backend-constructor
@@ -121,6 +122,25 @@ class FlatLaneBackend:
         self.docs = jax.tree.map(jnp.array, SA.stack_docs(base, lanes))
         self._empty = base
         self.shapes_seen: set = set()   # compiled (S,) tick shapes
+        # Device-resident prefill (ISSUE 14): ship only the per-tick
+        # scatter delta, keep the [B, OCAP] logs on device, and check
+        # capacity against HOST-MIRRORED per-lane counts — the dispatch
+        # edge then reads no device state at all (``dispatch_reads_
+        # device``), so the batcher skips its forced pre-dispatch sync
+        # and the in-flight step overlaps the whole next host tick.
+        self.device_prefill = device_prefill
+        self.dispatch_reads_device = not device_prefill
+        self.scatter_shapes_seen: set = set()  # compiled scatter buckets
+        # Host mirrors of the flat docs' n/next_order (exact: a tick
+        # advances them by its column sums, residency writes set them).
+        self._n_host = np.zeros(lanes, np.int64)
+        self._next_order_host = np.zeros(lanes, np.int64)
+        # Prefill cost accounting (the ledger/probe surface): bytes the
+        # chosen path moved vs what the full-log round trip would move,
+        # and the un-padded scatter volume.  All logical (seed-
+        # deterministic) — wall-free by the §15 cpu-cell rule.
+        self.prefill_stats = {"ticks": 0, "moved_bytes": 0,
+                              "full_bytes_equiv": 0, "scatter_len": 0}
 
     def fits(self, n: int, next_order: int) -> bool:
         """Would a doc of ``n`` rows / ``next_order`` orders fit a lane
@@ -148,12 +168,16 @@ class FlatLaneBackend:
         self.docs = jax.tree.map(
             lambda batched, one: batched.at[b].set(one),
             self.docs, self._empty)
+        self._n_host[b] = 0
+        self._next_order_host[b] = 0
 
     def upload_lane(self, b: int, oracle, rank_of_agent) -> None:
         flat = SA.upload_oracle(oracle, self.capacity, rank_of_agent,
                                 self.order_capacity)
         self.docs = jax.tree.map(
             lambda batched, one: batched.at[b].set(one), self.docs, flat)
+        self._n_host[b] = oracle.n
+        self._next_order_host[b] = oracle.get_next_order()
 
     def remap_lane_ranks(self, b: int, mapping: np.ndarray) -> None:
         import dataclasses
@@ -167,14 +191,73 @@ class FlatLaneBackend:
         self.docs = dataclasses.replace(
             self.docs, rank_log=self.docs.rank_log.at[b].set(new))
 
+    def _check_capacity_host(self, ops: B.OpTensors) -> None:
+        """The ONE flat capacity contract (``flat.check_capacity_
+        counts``) against the HOST-MIRRORED lane counts — same bounds,
+        same per-lane pairing, zero device reads (the mirrors are
+        exact: every accepted tick advances n by its ins_len column
+        sum and next_order by its order_advance sum, residency writes
+        reset them from the oracle)."""
+        F.check_capacity_counts(self._n_host, self._next_order_host,
+                                self.capacity, self.order_capacity, ops)
+
     def apply(self, stacked: B.OpTensors) -> None:
-        """One [S, B] tick: prefill the by-order logs host-side, then a
-        single jitted vmapped scan. Always the full (local+remote)
-        kernel variant so the tick mix can't flip compiled programs."""
-        F._check_capacity(self.docs, stacked)
-        docs = B.prefill_logs(self.docs, stacked)
+        """One [S, B] tick: prefill the by-order logs — on device from
+        the scatter delta (``device_prefill``, the shipped default) or
+        host-side via ``batch.prefill_logs`` — then a single jitted
+        vmapped scan. Always the full (local+remote) kernel variant so
+        the tick mix can't flip compiled programs.
+
+        The two paths are bit-identical in device state and logical
+        counters (tests/test_device_prefill.py); they differ only in
+        bytes moved (full-log round trip vs scatter-len delta,
+        ``prefill_stats``) and in whether the dispatch edge touches
+        device state at all."""
+        st = self.prefill_stats
+        st["ticks"] += 1
+        # What the full-log round trip would move for this tick: the
+        # four [B, OCAP] u32 logs, host-materialized AND re-uploaded.
+        st["full_bytes_equiv"] += 2 * 4 * self.lanes \
+            * self.order_capacity * 4
+        st["scatter_len"] += int(np.asarray(
+            stacked.ins_len, dtype=np.int64).sum())
         self.shapes_seen.add(int(stacked.num_steps))
+        if self.device_prefill:
+            self._check_capacity_host(stacked)
+            delta = B.prefill_delta(stacked)
+            docs = self.docs
+            if delta is not None:
+                self.scatter_shapes_seen.add(delta.bucket)
+                st["moved_bytes"] += delta.nbytes()
+                docs = F.apply_prefill_delta(docs, delta)
+        else:
+            F._check_capacity(self.docs, stacked)
+            docs = B.prefill_logs(self.docs, stacked)
+            st["moved_bytes"] += 2 * 4 * self.lanes \
+                * self.order_capacity * 4
         self.docs = F._apply_ops_batch(docs, stacked, local_only=False)
+        self._n_host += np.asarray(
+            stacked.ins_len, dtype=np.int64).sum(axis=0)
+        self._next_order_host += np.asarray(
+            stacked.order_advance, dtype=np.int64).sum(axis=0)
+
+    def prefill_summary(self) -> Dict[str, float]:
+        """Per-tick prefill byte economy (logical, seed-deterministic):
+        what moved host<->device for log prefill vs the full-log
+        baseline, the un-padded scatter volume, and the scatter
+        program's compile count."""
+        st = self.prefill_stats
+        ticks = max(st["ticks"], 1)
+        return {
+            "device_prefill": self.device_prefill,
+            "prefill_bytes_per_tick": round(st["moved_bytes"] / ticks, 1),
+            "prefill_bytes_full_per_tick": round(
+                st["full_bytes_equiv"] / ticks, 1),
+            "prefill_bytes_cut_x": round(
+                st["full_bytes_equiv"] / max(st["moved_bytes"], 1), 2),
+            "prefill_scatter_len": st["scatter_len"],
+            "prefill_scatter_compiles": len(self.scatter_shapes_seen),
+        }
 
     def barrier(self) -> None:
         np.asarray(self.docs.n)
@@ -203,7 +286,8 @@ def make_lane_backend(engine: str, *, lanes: int, capacity: int,
                       order_capacity: int, lmax: int,
                       block_k: int = 32,
                       interpret: Optional[bool] = None,
-                      fuse_w: int = 1):
+                      fuse_w: int = 1,
+                      device_prefill: bool = True):
     """Registry-driven lane-backend construction: ``engine`` must be
     registered for the ``serve`` config in ``config.ENGINE_REGISTRY``
     AND carry a ``serve_backend`` entry naming its backend class —
@@ -229,7 +313,8 @@ def make_lane_backend(engine: str, *, lanes: int, capacity: int,
         f"text_crdt_rust_tpu.{mod_path}"), cls_name)
     return cls(lanes=lanes, capacity=capacity,
                order_capacity=order_capacity, lmax=lmax,
-               block_k=block_k, interpret=interpret, fuse_w=fuse_w)
+               block_k=block_k, interpret=interpret, fuse_w=fuse_w,
+               device_prefill=device_prefill)
 
 
 def oracle_signed(oracle) -> np.ndarray:
@@ -833,7 +918,14 @@ class ContinuousBatcher:
                 # anyway, but inside the dispatch-wall window — this
                 # keeps disp_ms enqueue-only and charges un-hidden
                 # device time to the pipeline stall accounting.
-                self._sync_shard_inflight(shard)
+                # Backends whose dispatch path reads NO device state
+                # (the flat backend with device_prefill: delta scatter
+                # + host-mirrored capacity counts, ISSUE 14) skip the
+                # forced sync entirely — the dispatch is pure enqueue
+                # and the in-flight step overlaps through to its staged
+                # sync (wall-only; the logical stream cannot tell).
+                if getattr(backend, "dispatch_reads_device", True):
+                    self._sync_shard_inflight(shard)
                 t_dev = time.perf_counter()
                 backend.apply(stacked)
                 disp_ms = (time.perf_counter() - t_dev) * 1e3

@@ -33,15 +33,32 @@ Three things make this a backend rather than a replay driver:
    not chars, and leaf splits need free blocks, so ``fits`` cannot be
    the flat backend's char-count probe.  The backend tracks per-lane
    run-row occupancy host-side (upper-bounded by +2 rows per ACTIVE op
-   branch between barriers — a compiled local replace step fires both
-   the delete and the insert branch — trued up from the device at each
-   barrier) and bounds it by ``row_budget``: every split-born or seeded block holds at least
-   ``(K-1)//2`` rows, so running out of blocks requires at least
-   ``(NB-1) * (K-1)//2`` occupied rows — staying strictly below that
-   makes the kernel's capacity flag unreachable.  Overflow therefore
-   degrades host-side (``tick_fits``/``fits_doc`` refuse, residency
-   frees the lane) before the device could ever flag, same contract as
-   the flat backend, different unit.
+   branch — a compiled local replace step fires both the delete and
+   the insert branch) and bounds it by ``row_budget``: every
+   split-born or seeded block holds at least ``(K-1)//2`` rows, so
+   running out of blocks requires at least ``(NB-1) * (K-1)//2``
+   occupied rows — staying strictly below that makes the kernel's
+   capacity flag unreachable.  Overflow therefore degrades host-side
+   (``tick_fits``/``fits_doc`` refuse, residency frees the lane)
+   before the device could ever flag, same contract as the flat
+   backend, different unit.
+
+   **Pipeline-safe true-up (ISSUE 14, ROADMAP 7a).**  The bound used
+   to be trued up to the device's exact per-lane row counts at every
+   barrier — which forced the barrier to materialize the tick's
+   output before the next capacity probe could run, clamping this
+   backend to a serial pipeline (``max_pipeline_ticks`` 1).  The
+   true-up is now a HOST-MIRRORED model on a fixed logical schedule:
+   ``apply(t)`` re-bases ``_lane_rows`` from tick t-1's exact device
+   counts (whose staged sync has already completed at every depth; the
+   batcher's dispatch-edge sync guarantees it) plus tick t's
+   conservative growth — so the value every capacity probe reads is a
+   pure function of the tick index, byte-identical at pipeline depth 1
+   and 2 (``tests/test_serve_pipeline.py``), and at most ONE tick's
+   conservative over-estimate above exact.  Lanes touched by residency
+   writes since the previous apply keep their (exact) residency-seeded
+   counts instead of the stale device value.  ``max_pipeline_ticks``
+   is therefore 2: the serve tick overlaps on BOTH backends.
 """
 from __future__ import annotations
 
@@ -68,10 +85,18 @@ class LanesMixedLaneBackend:
     by-order table per lane (rounded up to a multiple of 8)."""
 
     engine = "rle-lanes-mixed"
+    # Pipeline-safe since ISSUE 14: the run-row bound is host-mirrored
+    # on a fixed logical schedule (see the module header), so the
+    # barrier no longer trues up state the next probe reads and the
+    # tick's device pass may stay in flight through the next host tick.
+    # Depth 2 is what the dispatch-edge sync guarantees cheap true-up
+    # reads for; deeper pipelines would partially serialize there.
+    max_pipeline_ticks = 2
 
     def __init__(self, lanes: int, capacity: int, order_capacity: int,
                  lmax: int, block_k: int = 64,
-                 interpret: Optional[bool] = None, fuse_w: int = 1):
+                 interpret: Optional[bool] = None, fuse_w: int = 1,
+                 device_prefill: bool = True):
         from ..config import lane_block_geometry
 
         self.lanes = lanes
@@ -97,9 +122,20 @@ class LanesMixedLaneBackend:
         # Host-accumulated full by-order rank table (the kernel's rkl is
         # a read-only input; see make_replayer_lanes_mixed's rkl doc).
         self._rkl = np.zeros((self.order_capacity, lanes), np.int32)
-        # Host upper bound on per-lane run rows (exact at barriers).
+        # device_prefill is flat-backend surface (this backend's
+        # by-order tables are device-resident already; only the rank
+        # prefill is host-merged) — accepted and ignored.
+        del device_prefill
+        # Host-mirrored per-lane run-row bound (see the module header):
+        # exact as of the LAST-BUT-ONE applied tick plus the newest
+        # tick's conservative growth; residency writes reset a lane to
+        # its exact seeded count.
         self._lane_rows = np.zeros(lanes, np.int64)
-        self._pending = None       # last tick's un-barriered result
+        self._prev_res = None      # last apply's result (true-up source)
+        self._prev_checked = False  # its kernel flags already verified
+        # Lanes written by clear/upload since the last apply: their
+        # _lane_rows value is fresher than _prev_res's device counts.
+        self._resident_fresh = np.zeros(lanes, bool)
         self.shapes_seen: set = set()   # compiled (S,) tick shapes
 
     # -- capacity probes ----------------------------------------------------
@@ -171,6 +207,7 @@ class LanesMixedLaneBackend:
             for s, e in zip(self._state, self._empty_cols))
         self._rkl[:, b] = 0
         self._lane_rows[b] = 0
+        self._resident_fresh[b] = True
 
     def upload_lane(self, b: int, oracle, rank_of_agent) -> None:
         """Seed lane ``b`` wholesale from a (restored) oracle: packed
@@ -225,6 +262,7 @@ class LanesMixedLaneBackend:
                 run_agents[run_idx]].astype(np.int32)
         self._rkl[:, b] = rkl
         self._lane_rows[b] = len(starts)
+        self._resident_fresh[b] = True
 
     def remap_lane_ranks(self, b: int, mapping: np.ndarray) -> None:
         """Agent-onboarding epoch re-base: rewrite lane ``b``'s column
@@ -243,9 +281,31 @@ class LanesMixedLaneBackend:
         """One [S, B] tick as a warm-started blocked-kernel chunk.  The
         batcher pads S to a static bucket, so ``chunk=S`` makes the
         shape-keyed kernel cache hold exactly one compiled program per
-        bucket."""
-        if self._pending is not None:
-            self.barrier()
+        bucket.
+
+        Run-row true-up rides the FIXED logical schedule the module
+        header documents: re-base from the PREVIOUS tick's exact device
+        counts (already synced — the batcher blocks this shard's
+        in-flight work at the dispatch edge, ``dispatch_reads_device``),
+        then add this tick's conservative growth.  The probes between
+        two applies therefore read exact(t-1) + growth(t) at EVERY
+        pipeline depth — the depth-invariance the byte-identity
+        contract needs — and the previous tick's kernel flags are
+        verified here, one tick late, still before any state built on
+        them is read back."""
+        growth = self._stream_growth(stacked.del_len, stacked.ins_len,
+                                     stacked.rows_per_step)
+        if self._prev_res is not None:
+            # Cheap: the dispatch-edge sync already materialized the
+            # previous tick's outputs on every pipeline depth.
+            exact = np.asarray(self._prev_res.rows)[0].astype(np.int64)
+            if not self._prev_checked:
+                self._prev_res.check()
+            base = np.where(self._resident_fresh, self._lane_rows, exact)
+        else:
+            base = self._lane_rows
+        self._lane_rows = base + growth
+        self._resident_fresh[:] = False
         S = int(stacked.num_steps)
         self._merge_rank_prefill(stacked)
         run = RLM.make_replayer_lanes_mixed_blocked(
@@ -255,9 +315,8 @@ class LanesMixedLaneBackend:
         res = run()
         self.shapes_seen.add(S)
         self._state = res.state()
-        self._pending = res
-        self._lane_rows = self._lane_rows + self._stream_growth(
-            stacked.del_len, stacked.ins_len, stacked.rows_per_step)
+        self._prev_res = res
+        self._prev_checked = False
 
     def _merge_rank_prefill(self, stacked: B.OpTensors) -> None:
         """Fold this tick's compile-known author ranks into the
@@ -274,21 +333,36 @@ class LanesMixedLaneBackend:
                     np.int32)
 
     def barrier(self) -> None:
-        """Materialize the tick's outputs; surface any kernel flag
-        loudly (the host-side probes make every flag unreachable, so a
-        raise here is a backend bug, not load) and true up the per-lane
-        run-row bound from the device's exact counts."""
-        res, self._pending = self._pending, None
-        if res is None:
-            return
-        res.check()
-        self._lane_rows = np.asarray(res.rows)[0].astype(np.int64).copy()
+        """Materialize the newest tick's outputs and surface any kernel
+        flag loudly (the host-side probes make every flag unreachable,
+        so a raise here is a backend bug, not load).  Deliberately NO
+        row true-up: the run-row bound follows the fixed logical
+        schedule in ``apply`` so capacity decisions cannot depend on
+        WHEN a barrier ran (the pipeline-depth byte-identity
+        contract)."""
+        if self._prev_res is not None and not self._prev_checked:
+            self._prev_res.check()
+            self._prev_checked = True
+
+    def sync_token(self):
+        """Device-completion handle for everything enqueued so far: the
+        newest result's per-lane row sums (tiny [1, B]) — blocking on
+        it waits for this backend's work through the current tick
+        without serializing later dispatches (the staged-sync contract
+        of ``max_pipeline_ticks`` > 1).  None before the first apply
+        (the batcher then falls back to ``barrier``, a no-op)."""
+        return self._prev_res.rows if self._prev_res is not None else None
 
     # -- readback -----------------------------------------------------------
 
     def lane_signed(self, b: int) -> np.ndarray:
         """±(order+1) body column of lane ``b`` in document order (walk
-        the logical block table; the bit-identity comparison target)."""
+        the logical block table; the bit-identity comparison target).
+        Readback implies a device sync, so the newest tick's kernel
+        flags are verified here too (the end-of-run path — at depth 2
+        no barrier ever runs, and the last tick's flags must still be
+        checked before its state is trusted)."""
+        self.barrier()
         ordp = np.asarray(self._state[0])[:, b]
         lenp = np.asarray(self._state[1])[:, b]
         nlog = int(np.asarray(self._state[2])[0, b])

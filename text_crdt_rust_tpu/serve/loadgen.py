@@ -527,6 +527,24 @@ class ServeLoadGen:
                 "stall_ms_total": tick_sum.get("pipeline_stall_ms_total",
                                                0.0),
             },
+            # Device-resident prefill (ISSUE 14): the per-tick log-
+            # prefill byte economy — delta scatter vs full-log round
+            # trip.  All logical (seed-deterministic); the flat backend
+            # is the only producer today.
+            "prefill": {
+                # Default False: a backend fleet that exposes no
+                # prefill surface (the lanes backend's tables are
+                # device-resident already) moves no prefill bytes.
+                "device_prefill": tick_sum.get("device_prefill", False),
+                "bytes_per_tick": tick_sum.get(
+                    "prefill_bytes_per_tick", 0.0),
+                "bytes_full_per_tick": tick_sum.get(
+                    "prefill_bytes_full_per_tick", 0.0),
+                "bytes_cut_x": tick_sum.get("prefill_bytes_cut_x", 0.0),
+                "scatter_len": tick_sum.get("prefill_scatter_len", 0),
+                "scatter_compiles": tick_sum.get(
+                    "prefill_scatter_compiles", 0),
+            },
             "wire": {
                 "format": self.wire,
                 "workload": self.workload,
@@ -674,6 +692,12 @@ def main(argv=None) -> None:
                          "work while the device step is in flight), "
                          "1 = the serial loop; logical streams are "
                          "byte-identical at any depth")
+    ap.add_argument("--host-prefill", action="store_true",
+                    help="disable device-resident prefill: round-trip "
+                         "the full by-order logs through host numpy "
+                         "every tick (the pre-ISSUE-14 path; logical "
+                         "streams are byte-identical either way — this "
+                         "is the probe's baseline arm)")
     ap.add_argument("--sanitize-pipeline", action="store_true",
                     help="pipeline aliasing sanitizer: CRC-fingerprint "
                          "each in-flight tick's op tensors at dispatch "
@@ -719,6 +743,7 @@ def main(argv=None) -> None:
                       lanes_per_shard=a.lanes,
                       wire_format=a.wire, ckpt_format=a.ckpt,
                       pipeline_ticks=a.pipeline_ticks,
+                      device_prefill=not a.host_prefill,
                       sanitize_pipeline=a.sanitize_pipeline,
                       nagle_txns=a.nagle_txns,
                       nagle_rounds=a.nagle_rounds, lmax=a.lmax,

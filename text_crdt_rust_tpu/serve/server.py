@@ -76,7 +76,8 @@ class DocServer:
                               order_capacity=cfg.order_capacity,
                               lmax=cfg.lmax, block_k=cfg.lanes_block_k,
                               interpret=cfg.interpret,
-                              fuse_w=cfg.fuse_w if cfg.fuse_steps else 1)
+                              fuse_w=cfg.fuse_w if cfg.fuse_steps else 1,
+                              device_prefill=cfg.device_prefill)
             for _ in range(cfg.num_shards)
         ]
         self.residency = LaneResidency(backends, self.router,
@@ -313,6 +314,31 @@ class DocServer:
             self.batcher.pipeline_overlap_frac(), 4)
         out["pipeline_stall_ms_total"] = round(
             self.batcher.sync_stall_s * 1e3, 3)
+        # Device-resident prefill (ISSUE 14): the per-tick log-prefill
+        # byte economy — what moved host<->device vs the full-log
+        # round trip, the scatter volume, and the scatter program's
+        # compile count.  Backends without the surface (the blocked
+        # lanes backend prefills only ranks, host-side) contribute
+        # nothing; the summed stats stay seed-deterministic.
+        pf = [b.prefill_summary() for b in self.residency.backends
+              if hasattr(b, "prefill_summary")]
+        if pf:
+            out["device_prefill"] = all(p["device_prefill"] for p in pf)
+            out["prefill_bytes_per_tick"] = round(
+                sum(p["prefill_bytes_per_tick"] for p in pf), 1)
+            out["prefill_bytes_full_per_tick"] = round(
+                sum(p["prefill_bytes_full_per_tick"] for p in pf), 1)
+            # max(.., 1): same floor as the backend's per-backend cut —
+            # a run that moved zero prefill bytes (no-insert streams)
+            # reports the full-log baseline as its cut, not a 1e9
+            # division artifact.
+            out["prefill_bytes_cut_x"] = round(
+                out["prefill_bytes_full_per_tick"]
+                / max(out["prefill_bytes_per_tick"], 1.0), 2)
+            out["prefill_scatter_len"] = sum(
+                p["prefill_scatter_len"] for p in pf)
+            out["prefill_scatter_compiles"] = sum(
+                p["prefill_scatter_compiles"] for p in pf)
         # Flight-recorder visibility (ISSUE 10 satellite): how many
         # post-mortem bundles this run wrote and how many same-reason
         # repeats were suppressed — a nonzero suppressed count in a
