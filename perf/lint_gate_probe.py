@@ -1,0 +1,141 @@
+"""Measure the tcrlint v2 gate cost model (ISSUE 15, PERF.md §20).
+
+Three walls + the loudness matrix, written to a committed JSON:
+
+- **full-cold**: whole-package lint, cache emptied first — the
+  worst-case weekly-style run;
+- **full-warm**: same walk again — every per-file verdict served from
+  the content-hash cache (the steady-state cost of the full fallback);
+- **changed**: ``--changed`` against the merge-base — the tier-1
+  gate's shipped mode (on a committed clean tree this lints 0 files
+  and prices only the project-level passes);
+- **injection matrix**: one seeded defect per check family through
+  ``run_lint``, recording that the family fires with its exact id —
+  the committed proof the claims tests re-check without re-measuring.
+
+Usage: ``python perf/lint_gate_probe.py [--out perf/lint_gate_r17.json]``
+"""
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import textwrap
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+CACHE = os.path.join(REPO, ".tcrlint_cache")
+
+#: One minimal seeded defect per family -> the check id it must raise.
+INJECTIONS = {
+    "TCR-W001": "import time\n\n\ndef f():\n    return time.time()\n",
+    "TCR-D001": "def f(x):\n    return hash(x)\n",
+    "TCR-D002": "def f(xs):\n    return list(set(xs))\n",
+    "TCR-D003": "import os\n\n\ndef f(d):\n    return os.listdir(d)\n",
+    "TCR-D004": "import random\n\n\ndef f():\n    return random.random()\n",
+    "TCR-F401": "import json\n\nX = 1\n",
+    "TCR-P001": textwrap.dedent("""\
+        def tick(backend, stacked):
+            backend.apply(stacked)
+            stacked.pos[0] = 7
+        """),
+    "TCR-M002": textwrap.dedent("""\
+        class NewBackend:
+            def seed(self, b):
+                self.state = self.state.at[b].set(0)
+        """),
+    "TCR-K001": textwrap.dedent("""\
+        def stage(stream, pad_ops):
+            return pad_ops(stream, 48)
+        """),
+}
+
+
+def lint_cli(*args):
+    t0 = time.perf_counter()
+    r = subprocess.run(
+        [sys.executable, "-m", "text_crdt_rust_tpu.analysis.lint",
+         "--json", *args],
+        capture_output=True, text=True, cwd=REPO, timeout=300)
+    wall = time.perf_counter() - t0
+    out = json.loads(r.stdout)
+    return {"wall_s": round(wall, 3), "rc": r.returncode,
+            "files": out["stats"]["files"],
+            "findings": len(out["findings"]),
+            "cache": out["stats"].get("cache"),
+            "mode": out["stats"].get("mode")}
+
+
+def injection_matrix():
+    from text_crdt_rust_tpu.analysis import run_lint
+    from text_crdt_rust_tpu.analysis.checks_shape import SHAPE_PINS_PATH
+
+    matrix = {}
+    for check, src in sorted(INJECTIONS.items()):
+        with tempfile.TemporaryDirectory() as td:
+            rel = ("text_crdt_rust_tpu/serve/mod.py"
+                   if check == "TCR-M002" else "mod.py")
+            full = os.path.join(td, rel)
+            os.makedirs(os.path.dirname(full), exist_ok=True)
+            with open(full, "w") as f:
+                f.write(src)
+            findings, _ = run_lint(
+                td, allowlist_path=os.path.join(td, "a.json"),
+                pins_path=os.path.join(td, "p.json"),
+                shape_pins_path=(SHAPE_PINS_PATH
+                                 if check == "TCR-K001"
+                                 else os.path.join(td, "sp.json")))
+            hits = [f.format() for f in findings if f.check == check]
+            matrix[check] = {"loud": bool(hits),
+                             "finding": hits[0] if hits else None}
+    # TCR-M001 and the C-family need richer trees; they are proven by
+    # tests/test_analysis_dataflow.py — recorded here by reference.
+    for check in ("TCR-M001", "TCR-C001", "TCR-C002", "TCR-C003"):
+        matrix[check] = {"loud": True,
+                         "finding": "tests/test_analysis_dataflow.py"}
+    return matrix
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(
+        REPO, "perf", "lint_gate_r17.json"))
+    a = ap.parse_args(argv)
+    if os.path.isdir(CACHE):
+        shutil.rmtree(CACHE)
+    full_cold = lint_cli()
+    full_warm = lint_cli()
+    changed = lint_cli("--changed")
+    matrix = injection_matrix()
+    report = {
+        "probe": "lint_gate_probe",
+        "round": 17,
+        "full_cold": full_cold,
+        "full_warm": full_warm,
+        "changed": changed,
+        "cache_hit_rate_warm": (
+            round(full_warm["cache"]["hits"]
+                  / max(1, full_warm["cache"]["hits"]
+                        + full_warm["cache"]["misses"]), 3)
+            if full_warm["cache"] else None),
+        "injection_matrix": matrix,
+        "all_families_loud": all(v["loud"] for v in matrix.values()),
+        "gate_budget_s": 15,
+        "inside_budget": (full_cold["wall_s"] < 15
+                          and changed["wall_s"] < 15),
+    }
+    with open(a.out, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(report, indent=1, sort_keys=True))
+    return 0 if (report["all_families_loud"]
+                 and report["inside_budget"]
+                 and full_cold["rc"] == 0) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

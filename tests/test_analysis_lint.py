@@ -282,11 +282,13 @@ def test_committed_allowlist_loads_and_every_entry_justified():
 
 
 def test_lint_gate_clean_tree_exits_zero():
-    """THE tier-1 lint gate: the shared entry point (tcrlint + ruff or
-    its fallback) over the shipped package must be clean.  Budget: the
-    conftest wall guard owns the suite; this asserts the lint alone
-    stays inside its 10s design target (generous headroom for slow
-    boxes — measured ~2s)."""
+    """THE tier-1 lint gate, full-tree flavor: the shared entry point
+    (tcrlint v2 + ruff or its fallback) over the shipped package must
+    be clean — the authoritative clean-tree proof behind the
+    ``--changed`` incremental gate (test_analysis_dataflow.py, which
+    honors the ``TCR_LINT_FULL=1`` weekly-style knob to force this
+    flavor there too).  Budget: < 15 s wall (ISSUE 15 acceptance;
+    measured ~3 s cold, ~0.3 s cache-warm)."""
     t0 = time.perf_counter()
     r = subprocess.run(
         [sys.executable, "-m", "text_crdt_rust_tpu.analysis.lint",
@@ -297,7 +299,7 @@ def test_lint_gate_clean_tree_exits_zero():
     out = json.loads(r.stdout)
     assert out["ok"] and not out["findings"]
     assert out["stats"]["files"] > 50  # the whole package walked
-    assert wall < 30, f"lint gate took {wall:.1f}s (design target 10s)"
+    assert wall < 15, f"lint gate took {wall:.1f}s (15s budget)"
 
 
 def test_lint_gate_fails_loud_on_all_four_families(tmp_path):

@@ -352,3 +352,70 @@ def test_host_mirrored_capacity_check_matches_device_counts():
         backend._next_order_host,
         np.asarray(backend.docs.next_order, dtype=np.int64))
     server.close_obs()
+
+
+def test_mirror_skip_injection_caught_by_runtime_and_lint(monkeypatch):
+    """ISSUE 15 satellite (host-mirror desync coverage): patch ONE
+    device-state write site — ``FlatLaneBackend.apply`` runs its
+    delta-prefill scatter + step scan but SKIPS its paired host-mirror
+    update — and assert BOTH guards name it:
+
+    - runtime: the ``host-mirror == device-count`` check (the test
+      above) goes false after one tick through the patched site;
+    - static: tcrlint's TCR-M001 names the same method when the mirror
+      updates are deleted from the source (the lint half, run here on
+      a mutated copy of the real file so the two halves pin the SAME
+      write site).
+    """
+    from text_crdt_rust_tpu.serve.batcher import FlatLaneBackend
+
+    real_apply = FlatLaneBackend.apply
+
+    def apply_skipping_mirrors(self, stacked):
+        n_before = self._n_host.copy()
+        next_before = self._next_order_host.copy()
+        real_apply(self, stacked)
+        # the seeded defect: the device advanced, the mirrors did not
+        self._n_host[:] = n_before
+        self._next_order_host[:] = next_before
+
+    monkeypatch.setattr(FlatLaneBackend, "apply", apply_skipping_mirrors)
+    server = DocServer(ServeConfig(engine="flat", num_shards=1,
+                                   lanes_per_shard=2))
+    server.admit_doc("d")
+    server.submit_local("d", "a", pos=0, ins_content="drifted")
+    server.tick()
+    server.drain()
+    backend = server.residency.backends[0]
+    assert not np.array_equal(
+        backend._n_host, np.asarray(backend.docs.n, dtype=np.int64)), \
+        "runtime host-mirror==device-count check failed to see the skip"
+    server.close_obs()
+
+    # The static half: the same write site with its mirror updates
+    # deleted from the SOURCE is a TCR-M001 naming the method.
+    import os
+    import tempfile
+
+    from text_crdt_rust_tpu.analysis import run_lint
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rel = "text_crdt_rust_tpu/serve/batcher.py"
+    src = open(os.path.join(repo, rel)).read()
+    cut = ("        self._n_host += np.asarray(\n"
+           "            stacked.ins_len, dtype=np.int64).sum(axis=0)\n"
+           "        self._next_order_host += np.asarray(\n"
+           "            stacked.order_advance, dtype=np.int64).sum(axis=0)\n")
+    assert cut in src, "seeded-defect anchor drifted"
+    with tempfile.TemporaryDirectory() as td:
+        full = os.path.join(td, rel)
+        os.makedirs(os.path.dirname(full))
+        with open(full, "w") as f:
+            f.write(src.replace(cut, ""))
+        findings, _ = run_lint(
+            td, [rel], allowlist_path=os.path.join(td, "a.json"),
+            pins_path=os.path.join(td, "p.json"),
+            shape_pins_path=os.path.join(td, "sp.json"))
+    named = [f for f in findings if f.check == "TCR-M001"
+             and f.scope == "FlatLaneBackend.apply"]
+    assert named, [f.format() for f in findings]

@@ -38,16 +38,47 @@ Check families (one module each):
                             ruff is not installed
 ==========================  ================================================
 
+**v2 — interprocedural dataflow families** (ISSUE 15): ``dataflow.py``
+grows per-function CFGs, reaching definitions, alias closures and
+one-level call summaries over the stdlib ``ast``; four flow-aware
+check families consume them:
+
+==========================  ================================================
+``checks_pipeline``         TCR-P001: dispatch-buffer escape — a host
+                            write that may alias a buffer handed to
+                            ``backend.apply``/the flat jits before its
+                            staged sync (the static twin of the PR-12
+                            runtime aliasing sanitizer, which stays on
+                            as defense-in-depth)
+``checks_mirror``           TCR-M001 a device-state write site without
+                            its paired host-mirror update (the PR-13
+                            capacity-contract model), TCR-M002 a serve
+                            backend class with device writes missing
+                            from ``MIRROR_CONTRACTS``
+``checks_shape``            TCR-K001 a static call-site shape off the
+                            declared bucket series, TCR-K002 series
+                            drift vs the pinned ``SHAPE_CONTRACTS.json``
+                            (refreshed via ``--update-pins``)
+``checks_claims``           TCR-C001 a cited ``perf/`` artifact that
+                            does not exist, TCR-C002 a superseded
+                            ``when_up_r*.sh`` in README's claims table,
+                            TCR-C003 a "measured" claims row with no
+                            committed source
+==========================  ================================================
+
 CLI: ``python -m text_crdt_rust_tpu.analysis.lint`` (exit 1 with
-file:line-named findings).  Allowlist: ``LINT_ALLOWLIST.json`` next to
-this file — every entry names (check, path, scope) plus a one-line
-justification, and a stale entry (matching nothing) is itself a
-finding, so the allowlist can only shrink or be re-justified.
+file:line-named findings; ``--changed`` for the incremental tier-1
+mode, content-hash cached under ``.tcrlint_cache/``).  Allowlist:
+``LINT_ALLOWLIST.json`` next to this file — every entry names
+(check, path, scope) plus a one-line justification, and a stale entry
+(matching nothing) is itself a finding, so the allowlist can only
+shrink or be re-justified.
 """
 from .tcrlint import (  # noqa: F401
     ALLOWLIST_PATH,
     PINS_PATH,
     Finding,
+    changed_files,
     load_allowlist,
     run_lint,
 )

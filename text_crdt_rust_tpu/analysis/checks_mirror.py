@@ -1,0 +1,251 @@
+"""TCR-M00x: device-state / host-mirror pairing (ISSUE 15).
+
+PR 13 moved the serve capacity contract onto HOST MIRRORS: the flat
+backend's ``_n_host``/``_next_order_host`` (and the lanes backend's
+``_lane_rows``/``_rkl``/``_resident_fresh``) must track the device
+state exactly, because every pre-dispatch probe reads the mirror and
+never the device.  The failure mode is structural: someone lands a new
+device-state write site (a ``.at[...].set`` reseed, a new
+``apply_prefill_delta`` call, a residency path) and forgets the paired
+mirror update — nothing crashes, the mirrors drift, and the capacity
+check silently reasons about a state that no longer exists.  The
+runtime guard (``host-mirror == device-count``,
+tests/test_device_prefill.py) only fires on paths a test happens to
+drive; this check makes the pairing a LINT contract:
+
+- **TCR-M001** — in a registered backend class (``MIRROR_CONTRACTS``,
+  keyed by class name so injected copies of the real files stay
+  checkable), every method that performs a device-state write must
+  also write at least one of the class's mirror attributes — directly,
+  or via a one-level call to another method of the same class whose
+  summary writes one (``dataflow.summarize_module``) — or carry a
+  scoped ``LINT_ALLOWLIST.json`` grant (e.g. a rank-only rewrite that
+  provably cannot move occupancy).
+
+  A *device-state write* is: an assignment to a registered device
+  attribute; any ``self.<attr> = <expr>`` whose RHS contains a
+  ``.at[...].set/add`` functional update; or a call to one of the flat
+  engine's device-writing producers — harvested from ``ops/flat.py``'s
+  AST when it is in the linted tree (functions containing ``.at[...]``
+  updates / ``dynamic_update_slice`` / ``lax.scan``, closed one call
+  level), with a pinned fallback list for partial trees.
+
+- **TCR-M002** — a class in ``serve/`` that writes ``.at[...]``-style
+  device state on ``self`` but is NOT registered in
+  ``MIRROR_CONTRACTS``: a new lane backend landed without declaring
+  its mirror contract.  Register it (or grant the scope) so M001 can
+  watch its write sites.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .dataflow import FnSummary, call_leaf, iter_functions, stmt_calls
+from .tcrlint import FileContext, Finding
+
+#: The registered backend mirror contracts, by CLASS name (path-free so
+#: the injection corpus can lint mutated copies of the real files).
+MIRROR_CONTRACTS = {
+    "FlatLaneBackend": {
+        "device": ("docs",),
+        "mirror": ("_n_host", "_next_order_host"),
+    },
+    "LanesMixedLaneBackend": {
+        "device": ("_state",),
+        "mirror": ("_lane_rows", "_rkl", "_resident_fresh"),
+    },
+}
+
+#: Fallback device-write producer names for partial trees where
+#: ``ops/flat.py`` is absent (the harvest supersedes this when it can
+#: run — see ``harvest_producers``).
+DEFAULT_PRODUCERS = frozenset({
+    "apply_prefill_delta", "_scatter_delta", "_scatter_delta_batch",
+    "_apply_ops", "_apply_ops_batch", "apply_ops", "apply_ops_batch",
+    "prefill_logs", "step",
+})
+
+PRODUCER_SOURCE = "text_crdt_rust_tpu/ops/flat.py"
+
+#: Directory prefix where M002 (unregistered device-state class)
+#: applies — new lane backends land here.
+M002_PREFIX = "text_crdt_rust_tpu/serve/"
+
+
+def harvest_producers(root: str) -> frozenset:
+    """Device-writing callables of the flat engine, from its AST: defs
+    whose body performs a functional device update (``.at[...].set``/
+    ``dynamic_update_slice``/``lax.scan``), plus (one level) defs that
+    call a harvested producer.  Falls back to the pinned list when the
+    source file is not under ``root`` (temp trees)."""
+    import os
+
+    path = os.path.join(root, PRODUCER_SOURCE)
+    if not os.path.exists(path):
+        return DEFAULT_PRODUCERS
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=PRODUCER_SOURCE)
+    direct: Set[str] = set()
+    calls: Dict[str, Set[str]] = {}
+    for qual, fn in iter_functions(tree):
+        leafs = {call_leaf(c) for c in stmt_calls(fn)}
+        calls[fn.name] = leafs
+        if _writes_device(fn):
+            direct.add(fn.name)
+    # one closure level: callers of device writers are device writers
+    out = set(direct)
+    for name, leafs in sorted(calls.items()):
+        if leafs & direct:
+            out.add(name)
+    return frozenset(out)
+
+
+def _writes_device(fn: ast.AST) -> bool:
+    for call in stmt_calls(fn):
+        leaf = call_leaf(call)
+        if leaf in ("dynamic_update_slice", "scan"):
+            return True
+        if leaf in ("set", "add") and isinstance(call.func, ast.Attribute):
+            # x.at[...].set(...) — the .at chain below the method
+            recv = call.func.value
+            if (isinstance(recv, ast.Subscript)
+                    and isinstance(recv.value, ast.Attribute)
+                    and recv.value.attr == "at"):
+                return True
+    return False
+
+
+def _at_set_in(node: ast.AST) -> bool:
+    """``.at[...].set/add`` anywhere inside an expression."""
+    for call in stmt_calls(node):
+        if (call_leaf(call) in ("set", "add")
+                and isinstance(call.func, ast.Attribute)):
+            recv = call.func.value
+            if (isinstance(recv, ast.Subscript)
+                    and isinstance(recv.value, ast.Attribute)
+                    and recv.value.attr == "at"):
+                return True
+    return False
+
+
+def _self_attr_target(t: ast.AST) -> Optional[str]:
+    """``attr`` when ``t`` is ``self.attr`` or ``self.attr[...]``."""
+    cur = t
+    while isinstance(cur, ast.Subscript):
+        cur = cur.value
+    if (isinstance(cur, ast.Attribute)
+            and isinstance(cur.value, ast.Name)
+            and cur.value.id in ("self", "cls")):
+        return cur.attr
+    return None
+
+
+def _method_mirror_writes(fn: ast.AST, mirrors: Set[str]) -> bool:
+    for node in ast.walk(fn):
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for t in targets:
+            attr = _self_attr_target(t)
+            if attr in mirrors:
+                return True
+    return False
+
+
+def _method_device_writes(fn: ast.AST,
+                          device: Set[str]) -> List[ast.AST]:
+    """Nodes performing a device-state write in one method: device-attr
+    assignments and ``.at[...].set`` self-stores (producer CALLS are a
+    separate detection in ``check`` — they mark the method even when
+    nothing lands on a registered attribute)."""
+    hits: List[ast.AST] = []
+    for node in ast.walk(fn):
+        targets: List[ast.AST] = []
+        value: Optional[ast.AST] = None
+        if isinstance(node, ast.Assign):
+            targets, value = list(node.targets), node.value
+        elif isinstance(node, ast.AnnAssign):
+            targets, value = [node.target], node.value
+        elif isinstance(node, ast.AugAssign):
+            targets, value = [node.target], node.value
+        for t in targets:
+            attr = _self_attr_target(t)
+            if attr is None:
+                continue
+            if attr in device:
+                hits.append(t)
+            elif value is not None and _at_set_in(value):
+                hits.append(t)
+    return hits
+
+
+def _self_method_calls(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for call in stmt_calls(fn):
+        if (isinstance(call.func, ast.Attribute)
+                and isinstance(call.func.value, ast.Name)
+                and call.func.value.id in ("self", "cls")):
+            out.add(call.func.attr)
+    return out
+
+
+def check(ctx: FileContext,
+          summaries: Optional[Dict[str, FnSummary]] = None,
+          producers: Optional[frozenset] = None) -> List[Finding]:
+    if producers is None:
+        producers = DEFAULT_PRODUCERS
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        contract = MIRROR_CONTRACTS.get(node.name)
+        methods = {m.name: m for m in node.body
+                   if isinstance(m, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))}
+        if contract is None:
+            # TCR-M002: unregistered serve-side device-state class.
+            if not ctx.rel.startswith(M002_PREFIX):
+                continue
+            for m in methods.values():
+                writes = _method_device_writes(m, set())
+                if writes:
+                    out.append(ctx.finding(
+                        "TCR-M002", writes[0],
+                        f"class {node.name} writes device state on "
+                        f"self but is not registered in "
+                        f"checks_mirror.MIRROR_CONTRACTS — declare "
+                        f"its device/mirror attribute contract so "
+                        f"TCR-M001 can watch new write sites"))
+                    break
+            continue
+        device = set(contract["device"])
+        mirrors = set(contract["mirror"])
+        mirror_methods = {name for name, m in sorted(methods.items())
+                          if _method_mirror_writes(m, mirrors)}
+        for name, m in sorted(methods.items()):
+            writes = _method_device_writes(m, device)
+            # a producer call on its own marks the method too (a
+            # device-writing call whose result is not stored on self
+            # still mutated donated/lane state on device).
+            if not writes:
+                prod_calls = [c for c in stmt_calls(m)
+                              if call_leaf(c) in producers]
+                writes = list(prod_calls)
+            if not writes:
+                continue
+            if name in mirror_methods:
+                continue
+            if _self_method_calls(m) & mirror_methods:
+                continue  # one-level pairing via a same-class helper
+            writes.sort(key=lambda n: getattr(n, "lineno", 0))
+            out.append(ctx.finding(
+                "TCR-M001", writes[0],
+                f"{node.name}.{name} writes device state but never "
+                f"updates a host mirror ({', '.join(sorted(mirrors))})"
+                f" — the PR-13 capacity contract reads mirrors, not "
+                f"the device; pair the write or add a justified "
+                f"allowlist grant for this scope"))
+    return out

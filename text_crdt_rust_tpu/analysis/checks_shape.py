@@ -1,0 +1,322 @@
+"""TCR-K00x: shape-contract checking against the pinned bucket series
+(ISSUE 15).
+
+Steady-state serving is compile-free only because every jitted call
+site draws its shapes from a small DECLARED series: tick step counts
+from ``ServeConfig.step_buckets``, prefill scatter lengths from
+``ops.batch.scatter_bucket``'s geometric series
+(``PREFILL_BUCKET_BASE * 4^k``), and the Pallas kernels' SMEM op-column
+counts from their ``in_specs``.  A new call site that invents its own
+shape compiles fine, runs fine, and silently recompiles every tick at
+scale — the exact leak the runtime ``shapes_seen`` asserts only catch
+on paths a test drives.  This check family pins the series and lints
+the call sites:
+
+- **TCR-K002** — the declared series are HARVESTED from the live AST
+  (``harvest_contracts``) and pinned in ``SHAPE_CONTRACTS.json`` next
+  to the engine; drift between the live tree and the pin is a finding,
+  refreshed via the existing ``--update-pins`` discipline (the same
+  re-pin-in-the-same-diff review moment as TCR-S003).  Pinned
+  surfaces: the scatter series (base + growth factor), the default
+  step buckets, and each kernel module's SMEM op-column count.
+
+- **TCR-K001** — call sites whose shape argument resolves statically
+  (a literal, or a name all of whose reaching definitions bind one int
+  — ``dataflow.FunctionFlow.const_int``) must land ON the pinned
+  series: ``pad_ops(stream, S)`` / ``empty_ops``-padded stacks /
+  ``chunk=`` of the blocked kernel builder against the step buckets,
+  ``PrefillDelta(..., bucket=L)`` / scatter-length pads against the
+  scatter series.  Dynamically-computed shapes are skipped — those
+  flow from the config at runtime and the ``shapes_seen`` asserts own
+  them; what the lint ratchets is the hard-coded off-series constant.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Dict, List, Optional
+
+from .dataflow import FunctionFlow, call_leaf, iter_functions
+from .tcrlint import FileContext, Finding
+
+SHAPE_PINS_PATH = os.path.join(os.path.dirname(__file__),
+                               "SHAPE_CONTRACTS.json")
+
+#: Where each declared series lives.
+BATCH_FILE = "text_crdt_rust_tpu/ops/batch.py"
+CONFIG_FILE = "text_crdt_rust_tpu/config.py"
+KERNEL_GLOB_DIR = "text_crdt_rust_tpu/ops"
+
+#: Call sites checked against the STEP-bucket series (argument position
+#: or keyword holding the shape — keyword names match the real
+#: signatures: ``pad_ops(ops, num_steps)``).
+STEP_SITES = {"pad_ops": (1, "num_steps"),
+              "make_replayer_lanes_mixed_blocked": (None, "chunk")}
+
+#: Call sites checked against the SCATTER series.
+SCATTER_SITES = {"PrefillDelta": (None, "bucket")}
+
+
+def _parse(root: str, rel: str) -> Optional[ast.Module]:
+    path = os.path.join(root, rel)
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        return ast.parse(f.read(), filename=rel)
+
+
+def _module_const(tree: ast.Module, name: str):
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (isinstance(t, ast.Name) and t.id == name
+                        and isinstance(node.value, ast.Constant)):
+                    return node.value.value
+        elif (isinstance(node, ast.AnnAssign)
+              and isinstance(node.target, ast.Name)
+              and node.target.id == name
+              and isinstance(node.value, ast.Constant)):
+            return node.value.value
+    return None
+
+
+def _scatter_factor(tree: ast.Module) -> Optional[int]:
+    """The geometric growth factor from ``scatter_bucket``'s body
+    (``b *= 4``)."""
+    for _qual, fn in iter_functions(tree):
+        if fn.name != "scatter_bucket":
+            continue
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.AugAssign)
+                    and isinstance(node.op, ast.Mult)
+                    and isinstance(node.value, ast.Constant)):
+                return node.value.value
+    return None
+
+
+def _step_buckets(tree: ast.Module) -> Optional[List[int]]:
+    """``ServeConfig.step_buckets`` default tuple."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "ServeConfig":
+            for stmt in node.body:
+                if (isinstance(stmt, ast.AnnAssign)
+                        and isinstance(stmt.target, ast.Name)
+                        and stmt.target.id == "step_buckets"
+                        and isinstance(stmt.value, ast.Tuple)):
+                    vals = [e.value for e in stmt.value.elts
+                            if isinstance(e, ast.Constant)]
+                    return vals if len(vals) == len(
+                        stmt.value.elts) else None
+    return None
+
+
+def _smem_count(node: ast.AST) -> Optional[int]:
+    """Number of SMEM op columns in an ``in_specs=`` expression: counts
+    ``smem()`` elements, ``[smem() for _ in range(N)]`` comprehensions,
+    and ``+``-concatenations thereof."""
+    if isinstance(node, ast.List):
+        total = 0
+        for elt in node.elts:
+            if isinstance(elt, ast.Call) and call_leaf(elt) == "smem":
+                total += 1
+        return total
+    if isinstance(node, ast.ListComp):
+        if (isinstance(node.elt, ast.Call)
+                and call_leaf(node.elt) == "smem"
+                and len(node.generators) == 1):
+            it = node.generators[0].iter
+            if (isinstance(it, ast.Call) and call_leaf(it) == "range"
+                    and it.args
+                    and isinstance(it.args[0], ast.Constant)):
+                return it.args[0].value
+        return 0
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left = _smem_count(node.left)
+        right = _smem_count(node.right)
+        if left is None or right is None:
+            return None
+        return left + right
+    return 0
+
+
+def _kernel_smem_columns(root: str) -> Dict[str, int]:
+    """Per kernel module, the max SMEM op-column count any of its
+    ``pallas_call(in_specs=...)`` sites declares."""
+    out: Dict[str, int] = {}
+    dirpath = os.path.join(root, KERNEL_GLOB_DIR)
+    if not os.path.isdir(dirpath):
+        return out
+    for fn in sorted(os.listdir(dirpath)):
+        if not fn.endswith(".py"):
+            continue
+        rel = f"{KERNEL_GLOB_DIR}/{fn}"
+        tree = _parse(root, rel)
+        if tree is None:
+            continue
+        best = 0
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and call_leaf(node) == "pallas_call"):
+                continue
+            for kw in node.keywords:
+                if kw.arg == "in_specs":
+                    count = _smem_count(kw.value)
+                    if count:
+                        best = max(best, count)
+        if best:
+            out[rel] = best
+    return out
+
+
+def harvest_contracts(root: str) -> Optional[dict]:
+    """The live declared-series state; None when none of the declaring
+    files exist under ``root`` (temp trees — nothing to pin)."""
+    out: dict = {}
+    batch = _parse(root, BATCH_FILE)
+    if batch is not None:
+        base = _module_const(batch, "PREFILL_BUCKET_BASE")
+        factor = _scatter_factor(batch)
+        if base is not None and factor is not None:
+            out["scatter-series"] = {"file": BATCH_FILE, "base": base,
+                                     "factor": factor, "depth": 6}
+    cfg = _parse(root, CONFIG_FILE)
+    if cfg is not None:
+        buckets = _step_buckets(cfg)
+        if buckets:
+            out["step-buckets"] = {"file": CONFIG_FILE,
+                                   "buckets": buckets}
+    smem = _kernel_smem_columns(root)
+    if smem:
+        out["smem-op-columns"] = smem
+    return out or None
+
+
+def check_shape_pins(root: str, pins_path: str,
+                     update: bool = False) -> List[Finding]:
+    """TCR-K002: live harvested series vs the committed pin; with
+    ``update=True`` rewrite the pin instead (the --update-pins
+    discipline)."""
+    live = harvest_contracts(root)
+    if live is None:
+        return []
+    pins_rel = os.path.relpath(pins_path, root).replace(os.sep, "/")
+    if update:
+        with open(pins_path, "w") as f:
+            json.dump({"comment":
+                       "tcrlint TCR-K shape contracts — the declared "
+                       "bucket series (scatter geometric series, "
+                       "serve step buckets, kernel SMEM op columns) "
+                       "harvested from the live AST; regenerate with "
+                       "python -m text_crdt_rust_tpu.analysis.lint "
+                       "--update-pins and commit alongside the series "
+                       "change that motivated it",
+                       "contracts": live}, f, indent=1, sort_keys=True)
+            f.write("\n")
+        return []
+    if not os.path.exists(pins_path):
+        return [Finding(
+            check="TCR-K002", path=pins_rel, line=1, scope="<pins>",
+            message="shape contracts pin file missing — run the lint "
+                    "with --update-pins and commit it")]
+    with open(pins_path) as f:
+        pinned = json.load(f)["contracts"]
+    out: List[Finding] = []
+    for name in sorted(set(live) | set(pinned)):
+        if name not in pinned:
+            out.append(Finding(
+                check="TCR-K002", path=pins_rel, line=1, scope="<pins>",
+                message=f"shape surface {name!r} has no pin — run "
+                        f"--update-pins and commit the diff"))
+        elif name not in live:
+            out.append(Finding(
+                check="TCR-K002", path=pins_rel, line=1, scope="<pins>",
+                message=f"pinned shape surface {name!r} no longer "
+                        f"harvests from the tree — re-pin "
+                        f"(--update-pins) or restore the series"))
+        elif live[name] != pinned[name]:
+            where = (live[name].get("file", pins_rel)
+                     if isinstance(live[name], dict) else pins_rel)
+            out.append(Finding(
+                check="TCR-K002", path=where, line=1, scope="<module>",
+                message=f"declared shape series {name!r} drifted from "
+                        f"its pin ({pinned[name]} -> {live[name]}) — "
+                        f"a bucket-series change re-keys the steady-"
+                        f"state compile set; re-pin (--update-pins) in "
+                        f"this same change so the diff shows it"))
+    return out
+
+
+# -- TCR-K001: static call-site shapes ---------------------------------------
+
+
+def load_series(pins_path: str = SHAPE_PINS_PATH) -> Optional[dict]:
+    if not os.path.exists(pins_path):
+        return None
+    with open(pins_path) as f:
+        return json.load(f)["contracts"]
+
+
+def _scatter_series(contract: dict) -> List[int]:
+    base, factor = contract["base"], contract["factor"]
+    return [base * factor ** k for k in range(contract.get("depth", 6))]
+
+
+def _shape_arg(call: ast.Call, pos: Optional[int],
+               kw: Optional[str]) -> Optional[ast.AST]:
+    if pos is not None and len(call.args) > pos:
+        return call.args[pos]
+    if kw is not None:
+        for k in call.keywords:
+            if k.arg == kw:
+                return k.value
+    return None
+
+
+def check(ctx: FileContext,
+          series: Optional[dict] = None) -> List[Finding]:
+    if series is None:
+        series = load_series()
+    if not series:
+        return []
+    steps = (series.get("step-buckets") or {}).get("buckets") or []
+    scatter = (_scatter_series(series["scatter-series"])
+               if "scatter-series" in series else [])
+    sites = []
+    if steps:
+        sites.append((STEP_SITES, steps, "step-bucket series",
+                      "ServeConfig.step_buckets"))
+    if scatter:
+        sites.append((SCATTER_SITES, scatter, "scatter-bucket series",
+                      "ops.batch.scatter_bucket"))
+    if not sites:
+        return []
+    out: List[Finding] = []
+    for _qual, fn in iter_functions(ctx.tree):
+        flow: Optional[FunctionFlow] = None
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = call_leaf(node)
+            for table, allowed, label, source in sites:
+                if leaf not in table:
+                    continue
+                arg = _shape_arg(node, *table[leaf])
+                if arg is None:
+                    continue
+                if flow is None:
+                    flow = FunctionFlow(fn)
+                at = flow.stmt_of(node, ctx.parents)
+                value = (flow.const_int(arg, at)
+                         if at is not None else None)
+                if value is None or value in allowed:
+                    continue
+                out.append(ctx.finding(
+                    "TCR-K001", node,
+                    f"{leaf}(...) pads to static shape {value}, off "
+                    f"the pinned {label} {allowed} ({source}) — an "
+                    f"off-series shape compiles its own program and "
+                    f"recompiles steady-state serving; draw the shape "
+                    f"from the declared series or extend the series "
+                    f"and re-pin (--update-pins)"))
+    return out

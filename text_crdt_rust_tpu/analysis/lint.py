@@ -5,7 +5,9 @@ lint test runs exactly this module):
 
 1. **tcrlint** — the project-invariant families (wall-clock
    segregation, determinism hazards, schema drift, recompile hazards,
-   F401 fallback) over the package;
+   F401 fallback) plus the v2 dataflow families (pipeline escape,
+   mirror pairing, shape contracts, claims consistency) over the
+   package;
 2. **ruff** — the third-party baseline (``pyproject.toml
    [tool.ruff]``, pyflakes+isort-level rules) when the binary is
    installed; its absence downgrades to the built-in TCR-F401
@@ -15,8 +17,20 @@ lint test runs exactly this module):
 Exit codes: 0 clean, 1 findings (each printed as
 ``path:line: CHECK-ID message``), 2 usage/config error.
 
-``--update-pins`` rewrites ``SCHEMA_PINS.json`` from the live schema
-surfaces (commit it together with the version bump that motivated it).
+``--update-pins`` rewrites ``SCHEMA_PINS.json`` AND
+``SHAPE_CONTRACTS.json`` from the live surfaces (commit them together
+with the change that motivated the re-pin).
+
+**Incremental mode** (ISSUE 15): ``--changed [BASE]`` lints only the
+.py files git reports changed vs BASE (default: the merge-base with
+main/master, else the working tree) — the project-level passes (schema
+pins, shape contracts, docs claims) always run, they are cheap.  The
+content-hash cache under ``.tcrlint_cache/`` makes even full-tree
+re-runs diff-priced; ``--no-cache`` disables it (the cache key folds
+in the engine version, allowlist, pins and the interprocedural
+summary sources, so a stale hit is structurally impossible).  The
+full-tree walk (no ``--changed``) is the weekly-style fallback and
+the authoritative clean-tree proof.
 """
 from __future__ import annotations
 
@@ -29,7 +43,8 @@ import sys
 import time
 from typing import List, Optional
 
-from .tcrlint import ALLOWLIST_PATH, PINS_PATH, run_lint
+from .checks_shape import SHAPE_PINS_PATH
+from .tcrlint import ALLOWLIST_PATH, PINS_PATH, changed_files, run_lint
 
 #: Default lint target, relative to the repo root.
 DEFAULT_TARGET = "text_crdt_rust_tpu"
@@ -84,8 +99,24 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="schema pins JSON (default: the committed "
                          "analysis/SCHEMA_PINS.json)")
     ap.add_argument("--update-pins", action="store_true",
-                    help="rewrite the schema pins from the live "
-                         "surfaces instead of checking them")
+                    help="rewrite the schema pins AND shape contracts "
+                         "from the live surfaces instead of checking "
+                         "them")
+    ap.add_argument("--shape-pins", default=None,
+                    help="shape contracts JSON (default: the committed "
+                         "analysis/SHAPE_CONTRACTS.json)")
+    ap.add_argument("--changed", nargs="?", const="auto", default=None,
+                    metavar="BASE",
+                    help="incremental mode: lint only .py files git "
+                         "reports changed vs BASE (default: merge-base "
+                         "with main/master); project-level passes "
+                         "always run")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="disable the .tcrlint_cache content-hash "
+                         "cache")
+    ap.add_argument("--cache-dir", default=None,
+                    help="cache directory (default: "
+                         "<root>/.tcrlint_cache)")
     ap.add_argument("--no-ruff", action="store_true",
                     help="skip the third-party ruff baseline")
     ap.add_argument("--json", action="store_true", dest="as_json",
@@ -102,6 +133,36 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"lint target {p!r} not found under {root}",
                   file=sys.stderr)
             return 2
+    mode = "full"
+    full_walk = True
+    if a.changed is not None:
+        from .tcrlint import SUMMARY_SOURCES
+
+        base = None if a.changed == "auto" else a.changed
+        try:
+            changed = changed_files(root, base)
+        except ValueError as e:  # typo'd/unfetched explicit base
+            print(f"tcrlint usage error: {e}", file=sys.stderr)
+            return 2
+        if changed is None:
+            # No git (tarball checkout): the weekly-style fallback is
+            # the full walk, and the summary says so.
+            mode = "full (--changed fell back: no git work tree)"
+        elif set(changed) & set(SUMMARY_SOURCES):
+            # A summary-source edit can induce cross-file TCR-P/TCR-M
+            # findings in UNCHANGED dependents (a new device-write
+            # producer in ops/flat.py makes an old call site in the
+            # batcher a finding) — the interprocedural soundness
+            # boundary demands the full walk, and the cache (whose
+            # digest just rotated on the same edit) keeps it cheap.
+            mode = ("full (--changed touched an interprocedural "
+                    "summary source)")
+        else:
+            prefixes = tuple(paths)
+            paths = [p for p in changed
+                     if p.startswith(prefixes) or p in prefixes]
+            mode = f"changed ({len(paths)} file(s) vs merge-base)"
+            full_walk = False
 
     t0 = time.perf_counter()  # lint wall for the summary line only
     try:
@@ -109,15 +170,22 @@ def main(argv: Optional[List[str]] = None) -> int:
             root, paths,
             allowlist_path=a.allowlist or ALLOWLIST_PATH,
             pins_path=a.pins or PINS_PATH,
+            shape_pins_path=a.shape_pins or SHAPE_PINS_PATH,
             update_pins=a.update_pins,
-            # Stale-grant findings only for the full default target: a
-            # partial lint never walked most granted files.
-            check_stale_allowlist=not a.paths)
+            use_cache=not a.no_cache,
+            cache_dir=a.cache_dir,
+            # Stale-grant findings only for full default-target walks:
+            # a partial lint never walked most granted files.  Boolean,
+            # not a mode-string compare — the --changed fallbacks ARE
+            # full walks and must keep the stale check.
+            check_stale_allowlist=not a.paths and full_walk)
     except ValueError as e:  # malformed allowlist
         print(f"tcrlint config error: {e}", file=sys.stderr)
         return 2
 
-    ruff = None if a.no_ruff else run_ruff(root, paths)
+    stats["mode"] = mode
+    ruff = (None if a.no_ruff or not paths
+            else run_ruff(root, paths))
     ruff_lines = ruff["lines"] if ruff else []
     wall = time.perf_counter() - t0
 
@@ -139,12 +207,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                      else f"ruff: {len(ruff_lines)} finding(s)" if ruff
                      else "ruff not installed — built-in TCR-F401 "
                           "fallback covered the F-level floor")
-        print(f"tcrlint: {stats['files']} files, "
+        cache = stats.get("cache")
+        cache_note = (f", cache {cache['hits']}h/{cache['misses']}m"
+                      if cache else "")
+        print(f"tcrlint[{mode}]: {stats['files']} files{cache_note}, "
               f"{len(findings)} finding(s), "
               f"{stats['allow_entries']} allowlist grants; {ruff_note} "
               f"({wall:.1f}s)", file=sys.stderr)
     if a.update_pins and not a.as_json:
-        print(f"schema pins rewritten: {a.pins or PINS_PATH}",
+        print(f"schema pins rewritten: {a.pins or PINS_PATH}; shape "
+              f"contracts rewritten: {a.shape_pins or SHAPE_PINS_PATH}",
               file=sys.stderr)
     return 1 if (findings or ruff_lines) else 0
 

@@ -20,14 +20,21 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import hashlib
 import json
 import os
+import subprocess
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 #: Committed allowlist + schema pins live next to the engine.
 ALLOWLIST_PATH = os.path.join(os.path.dirname(__file__),
                               "LINT_ALLOWLIST.json")
 PINS_PATH = os.path.join(os.path.dirname(__file__), "SCHEMA_PINS.json")
+
+#: Bump on any change to check logic or finding shapes: invalidates
+#: every incremental-cache entry (the cache key hashes this together
+#: with the allowlist/pins content and the call-summary digest).
+LINT_VERSION = 2
 
 #: Directories never walked (build junk; native/ holds generated .so
 #: trees; spool dirs can appear under a dev checkout).
@@ -116,8 +123,13 @@ def dotted_name(node: ast.AST) -> Optional[str]:
 def iter_py_files(root: str, paths: Optional[Sequence[str]] = None
                   ) -> Iterable[str]:
     """Root-relative .py paths under ``paths`` (files or directories),
-    sorted — the lint practices the determinism it preaches."""
-    targets = [os.path.join(root, p) for p in paths] if paths else [root]
+    sorted — the lint practices the determinism it preaches.  An empty
+    ``paths`` list means NO per-file targets (the ``--changed`` mode
+    with a clean diff: project-level passes still run)."""
+    if paths is not None and len(paths) == 0:
+        return []
+    targets = ([os.path.join(root, p) for p in paths]
+               if paths is not None else [root])
     out: List[str] = []
     for target in targets:
         if os.path.isfile(target):
@@ -196,6 +208,143 @@ def apply_allowlist(findings: List[Finding], entries: List[dict],
     return kept
 
 
+# -- changed-file selection (incremental mode) --------------------------------
+
+
+def changed_files(root: str, base: Optional[str] = None
+                  ) -> Optional[List[str]]:
+    """Root-relative .py files changed vs ``base`` (default: the
+    merge-base with main/master, falling back to HEAD — i.e. just the
+    working tree), union the untracked files.  None when git is
+    unavailable or ``root`` is not a work tree (callers fall back to
+    the full walk and say so); a CALLER-SUPPLIED base that git refuses
+    raises ``ValueError`` instead — a typo'd ref must be a usage
+    error, not a silent full walk blamed on git."""
+    def git(*args: str) -> Optional[str]:
+        try:
+            r = subprocess.run(["git", "-C", root, *args],
+                               capture_output=True, text=True,
+                               timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        return r.stdout if r.returncode == 0 else None
+
+    if git("rev-parse", "--git-dir") is None:
+        return None
+    explicit = base is not None
+    if base is None:
+        for cand in ("main", "master"):
+            mb = git("merge-base", "HEAD", cand)
+            if mb:
+                base = mb.strip()
+                break
+        base = base or "HEAD"
+    diff = git("diff", "--name-only", base)
+    if diff is None:
+        if explicit:
+            raise ValueError(
+                f"--changed base {base!r} is not a ref git can diff "
+                f"against (typo, or an unfetched remote ref?)")
+        return None
+    untracked = git("ls-files", "--others", "--exclude-standard") or ""
+    out = sorted({p for p in diff.splitlines() + untracked.splitlines()
+                  if p.endswith(".py")
+                  and os.path.exists(os.path.join(root, p))})
+    return out
+
+
+# -- incremental cache --------------------------------------------------------
+# Content-hash keyed per-file findings under <root>/.tcrlint_cache/ so
+# the tier-1 gate's cost tracks the DIFF, not the tree: a file whose
+# content hash matches reuses its raw findings; the config digest
+# (engine version + allowlist + pins + call-summary sources) guards
+# cross-file invalidation — a summary-source edit re-lints everything,
+# which is exactly the interprocedural soundness boundary.
+
+CACHE_DIR_NAME = ".tcrlint_cache"
+
+#: Modules whose one-level call summaries feed the interprocedural
+#: checks (TCR-P callee mutation, TCR-M producer harvest).  Their
+#: content is part of the cache config digest.
+SUMMARY_SOURCES = (
+    "text_crdt_rust_tpu/ops/batch.py",
+    "text_crdt_rust_tpu/ops/flat.py",
+    "text_crdt_rust_tpu/serve/batcher.py",
+    "text_crdt_rust_tpu/serve/lanes_backend.py",
+    "text_crdt_rust_tpu/serve/residency.py",
+)
+
+
+def _file_sha(path: str) -> str:
+    h = hashlib.sha256()
+    try:
+        with open(path, "rb") as f:
+            h.update(f.read())
+    except OSError:
+        h.update(b"<absent>")
+    return h.hexdigest()
+
+
+def _config_digest(root: str, allowlist_path: str, pins_path: str,
+                   shape_pins_path: str) -> str:
+    h = hashlib.sha256(f"tcrlint-v{LINT_VERSION}".encode())
+    for path in (allowlist_path, pins_path, shape_pins_path):
+        h.update(_file_sha(path).encode())
+    for rel in SUMMARY_SOURCES:
+        h.update(_file_sha(os.path.join(root, rel)).encode())
+    # The engine's OWN source: an edited check module must invalidate
+    # every cached verdict its old logic produced — "a stale hit is
+    # structurally impossible" has to hold without anyone remembering
+    # to bump LINT_VERSION by hand (the version stays as the knob for
+    # semantic changes that live outside this package, e.g. pin-file
+    # format migrations).
+    engine_dir = os.path.dirname(os.path.abspath(__file__))
+    for fn in sorted(os.listdir(engine_dir)):
+        if fn.endswith(".py"):
+            h.update(_file_sha(os.path.join(engine_dir, fn)).encode())
+    return h.hexdigest()
+
+
+class _Cache:
+    def __init__(self, root: str, digest: str,
+                 cache_dir: Optional[str] = None):
+        self.path = os.path.join(cache_dir or os.path.join(
+            root, CACHE_DIR_NAME), "cache.json")
+        self.digest = digest
+        self.hits = 0
+        self.misses = 0
+        self.entries: Dict[str, dict] = {}
+        try:
+            with open(self.path) as f:
+                data = json.load(f)
+            if data.get("digest") == digest:
+                self.entries = data.get("files", {})
+        except (OSError, ValueError):
+            pass
+
+    def get(self, rel: str, sha: str) -> Optional[List[Finding]]:
+        entry = self.entries.get(rel)
+        if entry is None or entry["sha"] != sha:
+            self.misses += 1
+            return None
+        self.hits += 1
+        return [Finding(**f) for f in entry["findings"]]
+
+    def put(self, rel: str, sha: str, findings: List[Finding]) -> None:
+        self.entries[rel] = {
+            "sha": sha,
+            "findings": [dataclasses.asdict(f) for f in findings]}
+
+    def save(self) -> None:
+        try:
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+            with open(self.path, "w") as f:
+                json.dump({"digest": self.digest, "files": self.entries},
+                          f, sort_keys=True)
+        except OSError:
+            pass  # a read-only tree still lints, just uncached
+
+
 # -- runner -------------------------------------------------------------------
 
 
@@ -207,41 +356,99 @@ def _check_modules():
             checks_recompile, checks_pyflakes)
 
 
+def _summary_map(root: str) -> Dict[str, "object"]:
+    """One-level call summaries over the summary-source modules present
+    under ``root`` (leaf-name keyed; first definition wins per the
+    dataflow module's contract)."""
+    from .dataflow import summarize_module
+
+    out: Dict[str, object] = {}
+    for rel in SUMMARY_SOURCES:
+        full = os.path.join(root, rel)
+        if not os.path.exists(full):
+            continue
+        try:
+            with open(full, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=rel)
+        except (SyntaxError, UnicodeDecodeError):
+            continue
+        for name, summary in sorted(summarize_module(tree).items()):
+            out.setdefault(name, summary)
+    return out
+
+
 def run_lint(root: str, paths: Optional[Sequence[str]] = None, *,
              allowlist_path: str = ALLOWLIST_PATH,
              pins_path: str = PINS_PATH,
+             shape_pins_path: Optional[str] = None,
              update_pins: bool = False,
-             check_stale_allowlist: Optional[bool] = None
+             check_stale_allowlist: Optional[bool] = None,
+             use_cache: bool = False,
+             cache_dir: Optional[str] = None
              ) -> Tuple[List[Finding], dict]:
-    """Lint ``paths`` (default: the whole root) and return
+    """Lint ``paths`` (default: the whole root; an explicit empty list
+    lints no files but still runs the project-level passes) and return
     ``(findings, stats)``.  Findings are sorted and allowlist-filtered;
     ``stats`` counts files/raw findings per check for the CLI summary.
+    ``use_cache`` enables the content-hash incremental cache under
+    ``<root>/.tcrlint_cache/`` (or ``cache_dir``).
     """
-    from . import checks_schema
+    from . import checks_claims, checks_mirror, checks_pipeline, \
+        checks_schema, checks_shape
 
+    if shape_pins_path is None:
+        shape_pins_path = checks_shape.SHAPE_PINS_PATH
     modules = _check_modules()
     raw: List[Finding] = []
     files = list(iter_py_files(root, paths))
     skipped: List[str] = []
+    cache = None
+    if use_cache:
+        cache = _Cache(root, _config_digest(
+            root, allowlist_path, pins_path, shape_pins_path),
+            cache_dir=cache_dir)
+    summaries = _summary_map(root)
+    producers = checks_mirror.harvest_producers(root) \
+        | checks_mirror.DEFAULT_PRODUCERS
+    shape_series = checks_shape.load_series(shape_pins_path)
     for rel in files:
         full = os.path.join(root, rel)
+        if cache is not None:
+            sha = _file_sha(full)
+            hit = cache.get(rel, sha)
+            if hit is not None:
+                raw.extend(hit)
+                continue
         try:
             with open(full, encoding="utf-8") as f:
                 source = f.read()
             tree = ast.parse(source, filename=rel)
         except (SyntaxError, UnicodeDecodeError) as e:
-            raw.append(Finding(check="TCR-P001", path=rel,
+            raw.append(Finding(check="TCR-E001", path=rel,
                                line=getattr(e, "lineno", 1) or 1,
                                scope="<module>",
                                message=f"unparseable: {e}"))
             skipped.append(rel)
             continue
         ctx = FileContext(rel, source, tree)
+        file_raw: List[Finding] = []
         for mod in modules:
-            raw.extend(mod.check(ctx))
-    # Project-level pass: schema fingerprints vs the committed pins.
+            file_raw.extend(mod.check(ctx))
+        file_raw.extend(checks_pipeline.check(ctx, summaries=summaries))
+        file_raw.extend(checks_mirror.check(ctx, producers=producers))
+        file_raw.extend(checks_shape.check(ctx, series=shape_series))
+        if cache is not None:
+            cache.put(rel, sha, file_raw)
+        raw.extend(file_raw)
+    if cache is not None:
+        cache.save()
+    # Project-level passes: schema fingerprints + shape contracts vs
+    # their committed pins, and the docs claims cross-check.
     raw.extend(checks_schema.check_pins(root, pins_path,
                                         update=update_pins))
+    raw.extend(checks_shape.check_shape_pins(root, shape_pins_path,
+                                             update=update_pins))
+    raw.extend(checks_claims.check_claims(root))
 
     entries = load_allowlist(allowlist_path)
     allowlist_rel = os.path.relpath(allowlist_path, root).replace(
@@ -259,5 +466,7 @@ def run_lint(root: str, paths: Optional[Sequence[str]] = None, *,
         per_check[f.check] = per_check.get(f.check, 0) + 1
     stats = {"files": len(files), "skipped": skipped,
              "raw_findings": len(raw), "findings": len(findings),
-             "allow_entries": len(entries), "per_check": per_check}
+             "allow_entries": len(entries), "per_check": per_check,
+             "cache": ({"hits": cache.hits, "misses": cache.misses}
+                       if cache is not None else None)}
     return findings, stats

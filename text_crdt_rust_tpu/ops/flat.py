@@ -308,6 +308,15 @@ def _check_capacity(doc: FlatDoc, ops: OpTensors) -> None:
 # program is independent of the tick's step bucket, so the serve
 # steady-state compile set is |step buckets| + |scatter buckets|, not
 # their product.
+#
+# tcrlint v2 contract (ISSUE 15): the functions below are this module's
+# DEVICE-WRITE PRODUCERS — analysis/checks_mirror.py harvests them from
+# this file's AST (``.at[...].set`` / ``dynamic_update_slice`` /
+# ``lax.scan`` bodies, closed one call level), and any serve backend
+# method that calls one or stores its result on a registered device
+# attribute must pair the write with a host-mirror update (TCR-M001).
+# They are also TCR-P001 dispatch sinks: a host write aliasing their
+# arguments before the staged sync is a lint finding.
 
 
 def _scatter_cols(ol, orr, rank, chars, ip, cv, rv, olp, olv, orp, orv):

@@ -18,6 +18,7 @@ annotated shardings, riding ICI inside a pod and DCN across hosts.
 """
 from __future__ import annotations
 
+import functools
 from typing import Optional, Sequence
 
 import jax
@@ -99,9 +100,17 @@ def shard_ops(ops, mesh: Mesh, batched: bool = True):
     )
 
 
+@functools.lru_cache(maxsize=16)
 def make_sharded_apply(mesh: Mesh, donate: bool = True,
                        prefill: bool = True):
     """The full multi-chip apply step, jitted over the mesh.
+
+    lru-cached by ``(mesh, donate, prefill)`` — ``jax.sharding.Mesh``
+    hashes by (devices, axis names), so re-building for the same mesh
+    returns the SAME jitted closure instead of re-tracing (the
+    ``_build_call`` pattern, round-17 allowlist burn-down; the old
+    grant claimed Mesh was not lru-hashable, which stopped being true
+    several jax versions ago).
 
     Returns ``apply(docs, ops) -> docs`` where docs are sharded
     ``P('dp','sp')`` and the time-major op stream is scanned with the doc
@@ -144,11 +153,13 @@ def make_sharded_apply(mesh: Mesh, donate: bool = True,
     return checked
 
 
+@functools.lru_cache(maxsize=16)
 def make_sharded_apply_1doc(mesh: Mesh, prefill: bool = True):
     """Sequence-parallel apply for ONE huge document: capacity axis sharded
     ``P('sp')`` across every chip in the mesh (long-context path).
 
-    ``prefill`` as in ``make_sharded_apply`` — required for fresh docs."""
+    ``prefill`` as in ``make_sharded_apply`` — required for fresh docs;
+    lru-cached per mesh like it too."""
     specs = doc_pspecs(batched=False)
     in_doc_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
 

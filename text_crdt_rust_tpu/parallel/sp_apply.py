@@ -52,7 +52,7 @@ single-device ``ops.rle_mixed`` storm, and the oracle
 """
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -86,6 +86,7 @@ def _shift2(x, amt):
                      jnp.where(amt == 1, jnp.roll(x, 1), jnp.roll(x, 2)))
 
 
+@lru_cache(maxsize=16)
 def make_sp_apply(mesh: Mesh, R: int, OTS: int):
     """Build the sharded FULL-SURFACE replayer for ``mesh`` (jitted).
 
@@ -94,6 +95,11 @@ def make_sp_apply(mesh: Mesh, R: int, OTS: int):
     lenp, rows, oll, orl, rkl, kind, pos, dlen, dtgt, olop, orop, rank,
     ilen, start)`` mapping sharded state + a replicated op stream [S]
     to (new state, per-op origin logs, error flags).
+
+    lru-cached by the full static geometry ``(mesh, R, OTS)`` (Mesh is
+    hashable) — two SpDocs with the same geometry share ONE compiled
+    replayer instead of re-tracing per doc (the ``_build_call``
+    pattern, round-17 allowlist burn-down).
     """
     spec = P("sp")
     none = P()

@@ -29,7 +29,7 @@ real ICI mesh unchanged.
 """
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -55,12 +55,15 @@ def _live_lens(ordp, lenp):
     return jnp.where(ordp > 0, lenp, 0)
 
 
+@lru_cache(maxsize=16)
 def make_sp_ops(mesh: Mesh):
     """Build the sharded lookup ops for ``mesh`` (jitted shard_map fns).
 
     Returns an object with ``live_prefix``, ``position_of_live_rank`` and
     ``order_to_position`` — each one shard-local compute + one small
-    collective over the ``sp`` axis.
+    collective over the ``sp`` axis.  lru-cached per mesh: the three
+    query jits are built once per geometry, not once per caller (the
+    ``_build_call`` pattern, round-17 allowlist burn-down).
     """
     spec = P("sp")
     none = P()

@@ -128,6 +128,12 @@ class FlatLaneBackend:
         # edge then reads no device state at all (``dispatch_reads_
         # device``), so the batcher skips its forced pre-dispatch sync
         # and the in-flight step overlaps the whole next host tick.
+        # The device/mirror pairing is a LINT contract (ISSUE 15): this
+        # class is registered in analysis/checks_mirror.MIRROR_CONTRACTS
+        # (device: docs; mirrors: _n_host/_next_order_host), so a new
+        # method that writes device state without updating a mirror —
+        # or without a justified allowlist grant, like the rank-only
+        # remap_lane_ranks — fails tier-1 as TCR-M001.
         self.device_prefill = device_prefill
         self.dispatch_reads_device = not device_prefill
         self.scatter_shapes_seen: set = set()  # compiled scatter buckets

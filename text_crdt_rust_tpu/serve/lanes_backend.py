@@ -129,7 +129,11 @@ class LanesMixedLaneBackend:
         # Host-mirrored per-lane run-row bound (see the module header):
         # exact as of the LAST-BUT-ONE applied tick plus the newest
         # tick's conservative growth; residency writes reset a lane to
-        # its exact seeded count.
+        # its exact seeded count.  Pairing is lint-enforced (ISSUE 15):
+        # the class is registered in analysis/checks_mirror.
+        # MIRROR_CONTRACTS (device: _state; mirrors: _lane_rows/_rkl/
+        # _resident_fresh) — a new device-write method without a mirror
+        # update fails tier-1 as TCR-M001.
         self._lane_rows = np.zeros(lanes, np.int64)
         self._prev_res = None      # last apply's result (true-up source)
         self._prev_checked = False  # its kernel flags already verified
