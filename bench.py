@@ -129,6 +129,15 @@ def init_devices(max_tries: int = 3):
 from text_crdt_rust_tpu.obs.ledger import LEDGER_SCHEMA_VERSION
 
 ROW_SCHEMA_VERSION = 1
+
+# Oldest cost-ledger schema whose row counters still MEAN the same
+# thing: ledger v2 only ADDED the "recovery" metric family (ISSUE 16),
+# so rows stamped v1 remain valid.  A breaking ledger change (a family
+# renamed/removed, a counter redefined) must raise this floor to the
+# new version so stale rows are refused again; the when_up watcher
+# re-stamps rows at the current version on every silicon re-record.
+LEDGER_COMPAT_FLOOR = 1
+
 ROW_SCHEMA = {
     "schema_version": (int,),
     # The cost-ledger schema the row was recorded against (ISSUE 10):
@@ -173,11 +182,13 @@ def validate_row(row: dict) -> None:
         problems.append(
             f"schema_version {row['schema_version']} != "
             f"{ROW_SCHEMA_VERSION} (re-record through this exporter)")
-    if not problems and row["ledger_version"] != LEDGER_SCHEMA_VERSION:
+    if not problems and (row["ledger_version"] < LEDGER_COMPAT_FLOOR
+                         or row["ledger_version"] > LEDGER_SCHEMA_VERSION):
         problems.append(
-            f"ledger_version {row['ledger_version']} != "
-            f"{LEDGER_SCHEMA_VERSION} (row counters were recorded "
-            f"against a drifted cost-ledger schema; re-record)")
+            f"ledger_version {row['ledger_version']} outside "
+            f"[{LEDGER_COMPAT_FLOOR}, {LEDGER_SCHEMA_VERSION}] (row "
+            f"counters were recorded against a drifted cost-ledger "
+            f"schema; re-record)")
     if problems:
         raise ValueError(
             f"bench row {row.get('config')!r} violates the exporter "

@@ -37,7 +37,18 @@ Cells (kind ``cpu`` — the tier-1 gate re-derives all of them):
   loadgen at FULL flow sampling — span terminal-state census
   (conservation audit asserted green before pinning) and
   op-age-at-apply percentiles in exact logical ticks, the ROADMAP-7
-  pipelined-tick before/after latency contract.
+  pipelined-tick before/after latency contract;
+- ``recovery``     — durability (ISSUE 16): the pinned post-dispatch
+  crash scenario (kill at a seeded tick with the depth-2 pipeline in
+  flight, recover from the journal, resume) — byte-identity to the
+  uncrashed twin and both crash-boundary conservation audits asserted
+  green BEFORE pinning; metrics are the journal byte bill (bytes/op,
+  vs the wire bill — the full-input-log floor, PERF.md §21) and the
+  replay economy (records / ops / ticks-to-recover, all logical);
+- ``flash-crowd``  — one hot doc takes 90% of traffic from a seeded
+  tick on (ISSUE 16 satellite): survives at pinned cost — lane
+  overflow degrades to the host oracle (counted, never an assert),
+  eviction/restore thrash pinned, convergence asserted.
 
 ``--device`` (perf/when_up_r11.sh) appends the silicon cells — wall
 histograms + real-HLO costs on the default backend, plus the flow
@@ -102,7 +113,18 @@ _COLLECTIVE_RE = re.compile(
     r"all-gather|all_gather|all-reduce|all_reduce|collective-permute|"
     r"collective_permute|all-to-all|all_to_all", re.IGNORECASE)
 
-CPU_CELLS = ("serve", "serve-lanes", "fused-trace", "sp", "flow")
+CPU_CELLS = ("serve", "serve-lanes", "fused-trace", "sp", "flow",
+             "recovery", "flash-crowd")
+
+#: The recovery cell's crash shape: two shards (TICK-marker duplication
+#: in play) under eviction pressure, killed post-dispatch mid-run.
+CHAOS_SHAPE = dict(num_shards=2, lanes_per_shard=2)
+CHAOS_CRASH_TICK = 3
+#: Flash-crowd shape: lanes far smaller than the crowd's appetite so
+#: the hot doc forces overflow-degrade + residency thrash.
+FLASH_TICK = 2
+FLASH_DOC = 1
+FLASH_SHAPE = dict(num_shards=1, lanes_per_shard=2)
 
 
 def _force_cpu():
@@ -386,6 +408,113 @@ def cell_flow():
     }
 
 
+def cell_recovery():
+    """Durability cell (ISSUE 16): the pinned post-dispatch crash —
+    kill at tick CHAOS_CRASH_TICK with the depth-2 pipeline in flight,
+    recover a fresh server by re-executing the journal, resume the
+    surviving clients, and require byte-identity to an uncrashed
+    same-seed twin plus green crash-boundary conservation audits —
+    all asserted BEFORE anything is pinned.
+
+    The byte metrics pin the full-input-log cost model (PERF.md §21):
+    ``journal_bytes_per_op`` is floored by the wire txn bytes/op (a
+    REC_TXNS body IS the columnar wire frame), and the control-plane
+    records (REQUEST/DIGEST/poll trajectory inputs) ride on top — the
+    ratio against the wire bill is pinned exactly so any journal-
+    format or trajectory-input change shows up as a named diff."""
+    from text_crdt_rust_tpu.serve.chaos import run_crash_scenario
+
+    cell = run_crash_scenario(
+        "post-dispatch", CHAOS_CRASH_TICK,
+        ticks=SMALL_LOADGEN["ticks"] + 3, docs=SMALL_LOADGEN["docs"],
+        agents_per_doc=SMALL_LOADGEN["agents_per_doc"],
+        events_per_tick=SMALL_LOADGEN["events_per_tick"], seed=SEED,
+        fault_rate=SMALL_LOADGEN["fault_rate"], **CHAOS_SHAPE)
+    assert cell["identical"], "recovered streams diverged from twin"
+    assert cell["converged"] and cell["twin_converged"]
+    assert cell["at_recovery_audit"]["audit_ok"], \
+        cell["at_recovery_audit"]["findings"]
+    assert cell["final_audit"]["audit_ok"], cell["final_audit"]["findings"]
+    rec = cell["recover"]
+    wire = cell["report"]["wire"]
+    jper = cell["journal_bytes_per_op"]
+    m = {
+        # The replay economy: what recovery re-executed, all logical.
+        "journal_records": metric(rec["records"], "recovery"),
+        "journal_refusals": metric(rec["refusals"], "recovery"),
+        "replayed_ops": metric(rec["ops"], "recovery"),
+        "replayed_txns": metric(rec["txns_replayed"], "recovery"),
+        "replayed_locals": metric(rec["locals_replayed"], "recovery"),
+        "replayed_frames": metric(rec["frames_replayed"], "recovery"),
+        "replayed_polls": metric(rec["polls_replayed"], "recovery"),
+        "ticks_to_recover": metric(rec["ticks"], "recovery"),
+        "docs_readmitted": metric(rec["docs"], "recovery"),
+        # The journal byte bill at the crash point (shipped fsync
+        # cadence = every tick), against the wire bill of the full run.
+        "journal_bytes": metric(cell["journal_bytes"], "recovery"),
+        "journal_ops": metric(cell["journal_ops"], "recovery"),
+        "journal_bytes_per_op": metric(jper, "recovery"),
+        "wire_txn_bytes_per_op": metric(wire["bytes_per_op"], "wire"),
+        "journal_vs_wire_txn_x": metric(
+            round(jper / wire["bytes_per_op"], 3), "recovery"),
+    }
+    return {
+        "kind": "cpu",
+        "workload": {**SMALL_LOADGEN, **CHAOS_SHAPE,
+                     "ticks": SMALL_LOADGEN["ticks"] + 3,
+                     "phase": "post-dispatch",
+                     "crash_tick": CHAOS_CRASH_TICK,
+                     "fsync_ticks": 1},
+        "metrics": m,
+    }
+
+
+def cell_flash_crowd():
+    """Flash-crowd cell (ISSUE 16 satellite): from FLASH_TICK on, 90%
+    of every tick's events slam doc FLASH_DOC while the lanes are far
+    too small for it — the hot doc must ride the overflow-degrade path
+    (host oracle, counted) and thrash eviction/restore, and the run
+    must still converge bit-identically.  Pinned so the degrade and
+    thrash economy of the hot-doc pathology is a named diff, not a
+    flaky incident."""
+    from text_crdt_rust_tpu.config import ServeConfig
+    from text_crdt_rust_tpu.serve.loadgen import ServeLoadGen
+
+    cfg = ServeConfig(engine="flat", lane_capacity=128,
+                      order_capacity=256, **FLASH_SHAPE)
+    gen = ServeLoadGen(cfg=cfg, **{**SMALL_LOADGEN, "ticks": 10,
+                                   "events_per_tick": 24},
+                       flash_crowd=(FLASH_TICK, FLASH_DOC))
+    rep = gen.run()
+    assert rep["converged"], rep["mismatches"][:4]
+    c = gen.server.counters
+    srv = rep["server"]
+    assert c.get("lane_overflow_degraded") > 0, \
+        "flash shape never overflowed — the cell tests nothing"
+    hot = gen.worlds[FLASH_DOC]
+    m = {
+        "item_ops_applied": metric(rep["item_ops_applied"], "steps"),
+        "hot_doc_chars": metric(len(hot.twin), "steps"),
+        "lane_overflow_degraded": metric(
+            c.get("lane_overflow_degraded"), "admission"),
+        "evictions": metric(srv.get("evictions", 0), "ckpt"),
+        "restores": metric(srv.get("restores", 0), "ckpt"),
+        "ckpt_bytes_written": metric(srv.get("ckpt_bytes_written", 0),
+                                     "ckpt"),
+        "rejected_submissions": metric(rep["rejected_submissions"],
+                                       "admission"),
+        "wire_txn_bytes": metric(rep["wire"]["txn_bytes"], "wire"),
+    }
+    return {
+        "kind": "cpu",
+        "workload": {**SMALL_LOADGEN, **FLASH_SHAPE, "ticks": 10,
+                     "events_per_tick": 24, "lane_capacity": 128,
+                     "order_capacity": 256,
+                     "flash_crowd": f"{FLASH_TICK}:{FLASH_DOC}"},
+        "metrics": m,
+    }
+
+
 def cell_fused_trace():
     """Generalized step fusion over a pinned real-trace prefix compiled
     at the serve lmax — the ISSUE-6 step economy as exact counters."""
@@ -554,6 +683,10 @@ def derive_cells(names=None) -> dict:
         out["sp"] = cell_sp()
     if "flow" in names:
         out["flow"] = cell_flow()
+    if "recovery" in names:
+        out["recovery"] = cell_recovery()
+    if "flash-crowd" in names:
+        out["flash-crowd"] = cell_flash_crowd()
     return out
 
 

@@ -1,0 +1,49 @@
+"""PR-16 acceptance run: the full crash matrix at the serve-200 shape.
+
+Every kill phase x fault rate {0, 10%} at docs=200 / 2 shards x 16
+lanes, crash at tick 30 of 60.  A cell is green when the recovered
+server's logical streams are sha256-identical to the uncrashed
+same-seed twin, the resumed workload converges, and the crash-boundary
+flow audit passes at recovery AND at the end of the run.  Writes
+``perf/crash_matrix_r15.json`` (the PERF.md §21 table source).
+
+Run:  JAX_PLATFORMS=cpu python perf/crash_matrix_r15.py
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from text_crdt_rust_tpu.serve.chaos import run_crash_matrix  # noqa: E402
+
+SHAPE = dict(crash_tick=30, ticks=60, docs=200, agents_per_doc=3,
+             events_per_tick=48, seed=7, num_shards=2,
+             lanes_per_shard=16, ckpt_format="delta")
+
+
+def main() -> int:
+    t0 = time.time()
+    out = run_crash_matrix(**SHAPE)
+    wall = time.time() - t0
+    rows = {}
+    for key, cell in out["cells"].items():
+        row = dict(cell)
+        row["journal_bytes_per_op"] = round(row["journal_bytes_per_op"], 3)
+        row["recover_wall_s"] = round(row["recover_wall_s"], 3)
+        rows[key] = row
+    doc = {"shape": SHAPE, "ok": out["ok"], "wall_s": round(wall, 1),
+           "cells": rows}
+    path = os.path.join(os.path.dirname(__file__), "crash_matrix_r15.json")
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps({"ok": out["ok"], "wall_s": doc["wall_s"],
+                      "cells": {k: v["green"] for k, v in rows.items()}},
+                     indent=1))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,131 @@
+#!/bin/bash
+# Round-15 recovery watcher (ISSUE 16 / durability): supersedes
+# when_up_r14.sh and keeps its gate chain — matmul tunnel probe ->
+# compile pin -> fused kevin device smoke -> device-prefill pipelined
+# serve smoke -> host-prefill arm -> sanitized pipelined smoke ->
+# fused serve-lanes smoke -> kevin full 5M -> remaining rows ->
+# cost-ledger device re-record.  New in r15: TWO recovery-on-device
+# smokes run before any re-record is trusted — (1) a JOURNALED
+# pipelined device run (the write-ahead journal on the hot path under
+# real async dispatch: the admission-edge append must not perturb the
+# logical stream, and convergence must hold with fsync-per-tick on),
+# and (2) a full crash/recover/resume cycle ON DEVICE via
+# --crash-at post-dispatch (kill with a depth-2 pipeline in flight,
+# replay the journal through the normal admission path, re-derive the
+# crashed tick, byte-compare against the uncrashed same-seed twin) —
+# on CPU this matrix is tier-1-proven (PERF.md §21); on silicon it is
+# the first time recovery replays REAL dispatched work.  Safe to
+# re-run; appends to perf/when_up_r15.log.
+set -u
+cd /root/repo
+while true; do
+  if timeout 240 python -c "
+import jax, numpy as np, jax.numpy as jnp
+x = jnp.ones((128,128), jnp.bfloat16)
+assert float(np.asarray(x @ x)[0,0]) == 128.0
+" >/dev/null 2>&1; then
+    echo "$(date -u +%H:%M:%S) tunnel is back (r15 watcher)" >> perf/when_up_r15.log
+    break
+  fi
+  echo "$(date -u +%H:%M:%S) still down (r15)" >> perf/when_up_r15.log
+  sleep 120
+done
+timeout 2400 python perf/compile_pin.py >> perf/compile_pin_r15.log 2>&1 \
+  || echo "PIN FAILED/TIMED OUT rc=$? - investigate before trusting bench" \
+       >> perf/compile_pin_r15.log
+# Fused-kernel device smoke first: a tiny fused kevin (2048 prepends,
+# W=8) proves the W-row splice compiles on real Mosaic before
+# committing to the 40-min full run.
+timeout 1800 python bench.py --config kevin --smoke --no-probe \
+  >> perf/when_up_r15.log 2>&1 \
+  || { echo "fused kevin device smoke FAILED rc=$? - NOT re-recording" \
+         >> perf/when_up_r15.log; exit 1; }
+# DEVICE-PREFILL pipelined serve smoke: the delta scatter +
+# double-buffered tick on real async dispatch.  Convergence + lane
+# bit-identity must hold before anything else is trusted.
+timeout 1800 python -m text_crdt_rust_tpu.serve.loadgen --device \
+  --docs 24 --ticks 10 --pipeline-ticks 2 \
+  >> perf/when_up_r15.log 2>&1 \
+  || { echo "device-prefill pipelined serve smoke FAILED rc=$? - NOT " \
+            "re-recording" >> perf/when_up_r15.log; exit 1; }
+# The HOST-PREFILL arm of the same seed: the two prefill paths must
+# stay byte-identical on silicon too (the ISSUE-14 contract the CPU
+# suite pins; a divergence here is a chip-side scatter bug).
+timeout 1800 python -m text_crdt_rust_tpu.serve.loadgen --device \
+  --docs 24 --ticks 10 --pipeline-ticks 2 --host-prefill \
+  >> perf/when_up_r15.log 2>&1 \
+  || { echo "host-prefill serve smoke FAILED rc=$? - NOT re-recording" \
+         >> perf/when_up_r15.log; exit 1; }
+# SANITIZED pipelined serve device smoke: the aliasing sanitizer under
+# real async dispatch.  A failure here is a REAL
+# host-write-races-device-step bug the CPU arms could never exhibit.
+timeout 1800 python -m text_crdt_rust_tpu.serve.loadgen --device \
+  --docs 24 --ticks 10 --pipeline-ticks 2 --sanitize-pipeline \
+  >> perf/when_up_r15.log 2>&1 \
+  || { echo "SANITIZED pipelined device smoke FAILED rc=$? - aliasing " \
+            "race on silicon? NOT re-recording" \
+         >> perf/when_up_r15.log; exit 1; }
+# JOURNALED pipelined device smoke (new in r15): the write-ahead
+# journal appending at the admission edge while real async device
+# steps are in flight.  The journal is host-side and logically
+# invisible by construction — this proves it stays that way when
+# dispatch is genuinely asynchronous (convergence gate; the journal
+# fsyncs every tick).
+rm -rf /tmp/tcr_r15_journal && mkdir -p /tmp/tcr_r15_journal
+timeout 1800 python -m text_crdt_rust_tpu.serve.loadgen --device \
+  --docs 24 --ticks 10 --pipeline-ticks 2 \
+  --journal-dir /tmp/tcr_r15_journal --journal-fsync-ticks 1 \
+  >> perf/when_up_r15.log 2>&1 \
+  || { echo "JOURNALED pipelined device smoke FAILED rc=$? - NOT " \
+            "re-recording" >> perf/when_up_r15.log; exit 1; }
+# CRASH/RECOVER device smoke (new in r15): kill post-dispatch with a
+# depth-2 pipeline in flight, recover a FRESH server from the journal
+# (replay through the normal admission path, re-derive the crashed
+# tick), resume the workload, and byte-compare logical streams
+# against the uncrashed same-seed twin — the PERF.md §21 contract,
+# first time on real hardware.  Exit 1 = digests differ or a
+# crash-boundary flow audit finding; NOT re-recording on that.
+timeout 1800 python -m text_crdt_rust_tpu.serve.loadgen --device \
+  --docs 16 --ticks 10 --crash-at post-dispatch:5 \
+  >> perf/when_up_r15.log 2>&1 \
+  || { echo "device CRASH/RECOVER smoke FAILED rc=$? - recovery " \
+            "divergence on silicon? NOT re-recording" \
+         >> perf/when_up_r15.log; exit 1; }
+# Fused serve-lanes loadgen smoke — the blocked mixed kernel's fused
+# splice + the serve stack's fused ticks on device; the lanes backend
+# PIPELINES at depth 2 (host-mirrored row true-up), so this smoke
+# also exercises its staged sync on real hardware.
+timeout 1800 python -m text_crdt_rust_tpu.serve.loadgen --device \
+  --docs 24 --ticks 10 --engine rle-lanes-mixed \
+  >> perf/when_up_r15.log 2>&1 \
+  || { echo "fused serve-lanes device smoke FAILED rc=$? - NOT re-recording" \
+         >> perf/when_up_r15.log; exit 1; }
+# Headline: kevin at full 5M, fused W=64 (rle-hbm-fused row).
+timeout 7200 python bench.py --config kevin --merge-rows --no-probe \
+  >> perf/bench_kevin_r15.log 2>&1 \
+  || echo "kevin re-record FAILED rc=$?" >> perf/when_up_r15.log
+# Remaining rows, most verdict-critical first; every merged row is
+# ledger_version-stamped by the exporter.
+for cfg in northstar 4 5r 5 serve serve-lanes sp; do
+  timeout 7200 python bench.py --config "$cfg" --merge-rows --no-probe \
+    >> "perf/bench_cfg${cfg}_r15.log" 2>&1 \
+    || echo "config $cfg re-record FAILED rc=$?" >> perf/when_up_r15.log
+done
+# The cost-ledger silicon cells: device-step wall histograms +
+# real-HLO costs + the flow-device per-op provenance cell, appended to
+# the committed ledger (cpu cells untouched).
+timeout 3600 python perf/cost_ledger_probe.py --device \
+  >> perf/when_up_r15.log 2>&1 \
+  || echo "ledger device re-record FAILED rc=$?" >> perf/when_up_r15.log
+# And prove the cpu contracts still hold from this very checkout:
+# cost ledger (now including the recovery + flash-crowd cells) + the
+# tcrlint gate (a drifted tree must not re-record).
+timeout 1800 env JAX_PLATFORMS=cpu python bench.py --check-ledger \
+  >> perf/when_up_r15.log 2>&1 \
+  || echo "LEDGER CHECK FAILED rc=$? - cpu cost contract drifted" \
+       >> perf/when_up_r15.log
+timeout 600 env JAX_PLATFORMS=cpu python -m text_crdt_rust_tpu.analysis.lint \
+  >> perf/when_up_r15.log 2>&1 \
+  || echo "TCRLINT FAILED rc=$? - determinism/schema finding on this checkout" \
+       >> perf/when_up_r15.log
+echo "$(date -u +%H:%M:%S) r15 re-record done" >> perf/when_up_r15.log

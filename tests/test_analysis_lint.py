@@ -252,6 +252,96 @@ def test_uncached_kernel_build_flagged_cached_passes(tmp_path):
     assert [f.line for f in the(findings, "TCR-R002")] == [9]
 
 
+# ---------------------------------------------- family 6: exceptions --------
+
+
+def test_silent_swallow_in_serve_flagged(tmp_path):
+    findings, _ = lint_tree(tmp_path, {"serve/mod.py": """\
+        def ingest(frame):
+            try:
+                return frame.decode()
+            except ValueError:
+                pass
+        """})
+    hits = the(findings, "TCR-X001")
+    assert hits[0].path == "serve/mod.py"
+    assert hits[0].line == 4
+    assert "ValueError" in hits[0].message
+
+
+def test_swallow_outside_serve_net_not_flagged(tmp_path):
+    findings, _ = lint_tree(tmp_path, {"ops/mod.py": """\
+        def probe(x):
+            try:
+                return x()
+            except ValueError:
+                pass
+        """})
+    assert not [f for f in findings if f.check == "TCR-X001"]
+
+
+def test_reported_handlers_pass(tmp_path):
+    """Every sanctioned discipline: re-raise, typed conversion (raised
+    OR constructed by value), notifier call, rejection recorder, and
+    the inline-tally AugAssign."""
+    findings, _ = lint_tree(tmp_path, {"net/mod.py": """\
+        class WireError(Exception):
+            pass
+
+
+        def a(frame):
+            try:
+                return frame.decode()
+            except ValueError:
+                raise WireError("bad frame")
+
+
+        def b(frame, counters):
+            try:
+                return frame.decode()
+            except ValueError:
+                counters.incr("frames_rejected")
+
+
+        def c(frame, stats):
+            try:
+                return frame.decode()
+            except ValueError:
+                stats["rejected"] += 1
+
+
+        def d(frame, router):
+            try:
+                return frame.decode()
+            except ValueError as e:
+                router.reject_frame(str(e))
+
+
+        def e(frame):
+            try:
+                return frame.decode(), None
+            except ValueError as exc:
+                return None, WireError(str(exc))
+        """})
+    assert not [f for f in findings if f.check == "TCR-X001"]
+
+
+def test_swallow_allowlist_grantable(tmp_path):
+    findings, _ = lint_tree(tmp_path, {"serve/mod.py": """\
+        def skip_foreign(names):
+            out = []
+            for n in names:
+                try:
+                    out.append(int(n))
+                except ValueError:
+                    continue
+            return out
+        """}, allow=[{"check": "TCR-X001", "path": "serve/mod.py",
+                      "scope": "skip_foreign",
+                      "why": "filename-pattern filter, not an op-path fault"}])
+    assert not [f for f in findings if f.check == "TCR-X001"]
+
+
 # ---------------------------------------------- ruff fallback ---------------
 
 

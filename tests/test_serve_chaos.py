@@ -1,0 +1,185 @@
+"""serve/chaos (ISSUE 16): deterministic crash injection, byzantine
+traffic, and the flash-crowd scenario.
+
+Every kill phase must recover to logical streams byte-identical to an
+uncrashed same-seed twin, with the crash-boundary conservation audit
+green — and the audit must be PROVEN loud by the journal-record-drop
+injection (a silent hole the CRC chain cannot see).  The byzantine and
+flash-crowd scenarios pin the admission edge's behavior under hostile
+and pathological traffic: typed refusals, counted, never a panic.
+"""
+import pytest
+
+from text_crdt_rust_tpu.config import ServeConfig
+from text_crdt_rust_tpu.serve import journal as J
+from text_crdt_rust_tpu.serve.chaos import (PHASES, run_crash_scenario,
+                                            run_crash_matrix)
+from text_crdt_rust_tpu.serve.loadgen import ServeLoadGen
+
+SMALL = dict(ticks=8, docs=6, agents_per_doc=2, events_per_tick=10,
+             seed=7, fault_rate=0.10, num_shards=2, lanes_per_shard=2)
+
+
+def _assert_green(cell):
+    assert cell["identical"], \
+        f"recovered digest diverged from twin: {cell['digest']} " \
+        f"vs {cell['twin_digest']}"
+    assert cell["converged"] and cell["twin_converged"]
+    assert cell["at_recovery_audit"]["audit_ok"], \
+        cell["at_recovery_audit"]["findings"]
+    assert cell["final_audit"]["audit_ok"], cell["final_audit"]["findings"]
+
+
+@pytest.mark.parametrize("phase", PHASES)
+def test_crash_phase_recovers_byte_identical(phase):
+    kw = dict(SMALL)
+    crash_tick = 3
+    if phase == "mid-ckpt":
+        # A checkpoint can only be torn once eviction pressure has
+        # written one: more docs than lanes, crash later in the run.
+        kw.update(ticks=9, docs=8, events_per_tick=12)
+        crash_tick = 4
+    cell = run_crash_scenario(phase, crash_tick, **kw)
+    _assert_green(cell)
+    assert cell["recover"]["ops"] > 0
+    if phase in ("mid-journal", "mid-ckpt"):
+        # The torn file must exist and be refused loudly, not absorbed.
+        assert cell["torn"]
+    if phase == "mid-journal":
+        assert cell["recover"]["refusals"] >= 1
+
+
+def test_crash_single_shard_torn_marker():
+    """One shard means NO surviving duplicate of the torn TICK marker:
+    recovery must re-derive the crashed tick live from the queued op
+    records."""
+    cell = run_crash_scenario("mid-journal", 3, ticks=8, docs=6,
+                              agents_per_doc=2, events_per_tick=10,
+                              seed=7, fault_rate=0.10, num_shards=1,
+                              lanes_per_shard=4)
+    _assert_green(cell)
+
+
+def test_crash_clean_channel():
+    """fault_rate 0: no anti-entropy traffic to mask recovery bugs."""
+    cell = run_crash_scenario("post-dispatch", 3,
+                              **{**SMALL, "fault_rate": 0.0})
+    _assert_green(cell)
+
+
+def test_journal_record_drop_is_loud():
+    """THE loudness proof: rewrite the journal without one op record,
+    CRCs re-chained so the storage layer cannot tell — the at-recovery
+    conservation audit must report the hole as a crash-leak.  (The
+    content digest would NOT catch this: the resumed anti-entropy cycle
+    heals it, which is exactly why the audit runs first.)"""
+    cell = run_crash_scenario("post-dispatch", 4,
+                              **{**SMALL, "ticks": 9},
+                              drop_record_kind=J.REC_TXNS, run_twin=False)
+    assert cell["dropped_seq"] is not None
+    audit = cell["at_recovery_audit"]
+    assert not audit["audit_ok"], \
+        "a silently dropped journal record went unnoticed"
+    assert any(f["kind"] == "crash-leak" for f in audit["findings"])
+
+
+@pytest.mark.slow
+def test_crash_matrix_small():
+    out = run_crash_matrix(crash_tick=3, ticks=9, docs=8,
+                           agents_per_doc=2, events_per_tick=12, seed=7)
+    assert out["ok"], {k: v for k, v in out["cells"].items()
+                       if not v["green"]}
+
+
+# -- byzantine traffic -------------------------------------------------------
+
+
+def test_byzantine_traffic_rejected_typed_and_counted(tmp_path):
+    """Every byzantine frame is either refused with a typed error
+    (counted as a rejection) or absorbed as a duplicate — the tick loop
+    never panics, the run still converges, and legitimate traffic is
+    untouched (same-seed reports match a byzantine-free run op for op)."""
+    kw = dict(docs=6, agents_per_doc=2, ticks=8, events_per_tick=10,
+              seed=11, fault_rate=0.10)
+    cfg = ServeConfig(num_shards=2, lanes_per_shard=2)
+    clean = ServeLoadGen(cfg=cfg, **kw).run()
+    assert clean["converged"]
+    cfg2 = ServeConfig(num_shards=2, lanes_per_shard=2)
+    gen = ServeLoadGen(cfg=cfg2, byzantine=0.5, **kw)
+    report = gen.run()
+    assert report["converged"], report["mismatches"]
+    byz = report["byzantine"]
+    assert byz["sent"] > 0
+    assert byz["sent"] == byz["rejected"] + byz["absorbed"], \
+        "a byzantine frame vanished untyped (neither refused nor absorbed)"
+    assert byz["rejected"] > 0
+    # The byzantine rng is a separate stream: the legitimate workload
+    # is byte-identical, so the servers converge to the same ops.
+    assert report["wire"]["ops_replicated"] == clean["wire"]["ops_replicated"]
+    assert report["item_ops_applied"] == clean["item_ops_applied"]
+    # Refusals were typed at the admission/codec edge, and the flight
+    # recorder saw the first of each class instead of a panic.
+    srv = report["server"]
+    rejected = sum(v for k, v in srv.items()
+                   if k.startswith("rejected_") and isinstance(v, int))
+    assert rejected >= byz["rejected"]
+
+
+def test_byzantine_with_journal_recovers(tmp_path):
+    """Byzantine garbage must never reach the journal (only ADMITTED
+    inputs are logged): a recovery after a hostile run replays clean."""
+    from text_crdt_rust_tpu.serve.chaos import logical_stream_digest
+    from text_crdt_rust_tpu.serve.server import DocServer
+    cfg = ServeConfig(num_shards=2, lanes_per_shard=2,
+                      journal_dir=str(tmp_path / "journal"),
+                      spool_dir=str(tmp_path / "spool"))
+    gen = ServeLoadGen(cfg=cfg, docs=4, agents_per_doc=2, ticks=6,
+                       events_per_tick=8, seed=11, fault_rate=0.10,
+                       byzantine=0.5)
+    report = gen.run()
+    assert report["converged"]
+    want = logical_stream_digest(gen.server)
+    cfg2 = ServeConfig(num_shards=2, lanes_per_shard=2,
+                       journal_dir=cfg.journal_dir,
+                       spool_dir=cfg.spool_dir)
+    server2 = DocServer(cfg2)
+    stats = server2.recover()
+    assert stats["refusals"] == 0
+    assert logical_stream_digest(server2) == want
+    server2.close_obs()
+
+
+# -- flash crowd -------------------------------------------------------------
+
+
+def test_flash_crowd_survives_and_converges():
+    """From the flash tick on, 90% of traffic slams one doc: lane
+    overflow + residency thrash on the hot doc.  The run must converge
+    at full fault rate — degrade to the host oracle if the lane
+    overflows, never assert."""
+    cfg = ServeConfig(num_shards=1, lanes_per_shard=2, lane_capacity=192,
+                      order_capacity=384)
+    gen = ServeLoadGen(cfg=cfg, docs=8, agents_per_doc=2, ticks=12,
+                       events_per_tick=16, seed=11, fault_rate=0.10,
+                       flash_crowd=(4, 2))
+    report = gen.run()
+    assert report["converged"], report["mismatches"]
+    # The crowd concentrated: the hot doc absorbed most post-flash ops.
+    hot = gen.worlds[2 % len(gen.worlds)]
+    sizes = sorted(len(w.twin) for w in gen.worlds)
+    assert len(hot.twin) == sizes[-1], \
+        "flash crowd never concentrated on the hot doc"
+
+
+def test_flash_crowd_preflash_identical():
+    """The remap draws its rng AFTER the base picks: ticks before the
+    flash point are byte-identical to a plain run."""
+    kw = dict(docs=6, agents_per_doc=2, ticks=4, events_per_tick=10,
+              seed=17, fault_rate=0.0)
+    plain = ServeLoadGen(cfg=ServeConfig(num_shards=1, lanes_per_shard=6),
+                         **kw).run()
+    flash = ServeLoadGen(cfg=ServeConfig(num_shards=1, lanes_per_shard=6),
+                         flash_crowd=(4, 0), **kw).run()
+    assert plain["converged"] and flash["converged"]
+    assert plain["wire"]["ops_replicated"] == flash["wire"]["ops_replicated"]
+    assert plain["item_ops_applied"] == flash["item_ops_applied"]

@@ -1,0 +1,239 @@
+"""serve/journal + DocServer.recover (ISSUE 16): the write-ahead input
+log and its re-execution recovery path.
+
+The journal is a FULL input log — every state-mutating call that
+crosses the admission edge, in order — and recovery re-executes it
+through the normal admission -> buffer -> batcher path.  The tests here
+pin the storage contract (CRC-chained records, torn tails refused with
+a typed error naming segment and offset, valid prefix always
+recovered), the end-to-end byte-identity of a recovered server, and the
+batcher's crash-path bugfix (a typed error mid-tick drains the
+in-flight pipeline instead of leaking staged syncs).
+"""
+import os
+
+import pytest
+
+from text_crdt_rust_tpu.config import ServeConfig
+from text_crdt_rust_tpu.serve import journal as J
+from text_crdt_rust_tpu.serve.chaos import logical_stream_digest
+from text_crdt_rust_tpu.serve.loadgen import ServeLoadGen
+from text_crdt_rust_tpu.serve.server import DocServer
+
+
+# -- journal storage contract ------------------------------------------------
+
+
+def _small_journal(tmp_path):
+    """A one-shard journal with a handful of mixed records; returns
+    (dir, baseline records)."""
+    d = str(tmp_path / "jr")
+    jr = J.Journal(d, num_shards=1)
+    jr.admit(0, "docA")
+    jr.frame(0, "docA", b"\x07payload")
+    jr.local(0, "docA", "editor", 3, 1, "xy", 0)
+    jr.tick(1)
+    jr.admit(0, "docB")
+    jr.poll(0, "docB")
+    jr.tick(2)
+    jr.close()
+    records, errors = J.scan(d)
+    assert not errors
+    return d, records
+
+
+def test_journal_roundtrip_order_and_bodies(tmp_path):
+    d, records = _small_journal(tmp_path)
+    kinds = [r.kind for r in records]
+    assert kinds == [J.REC_ADMIT, J.REC_FRAME, J.REC_LOCAL, J.REC_TICK,
+                     J.REC_ADMIT, J.REC_POLL, J.REC_TICK]
+    assert [r.seq for r in records] == list(range(7))
+    assert records[0].body.decode() == "docA"
+    doc_id, data = J.decode_frame_body(records[1].body)
+    assert (doc_id, data) == ("docA", b"\x07payload")
+    assert J.decode_local_body(records[2].body) == \
+        ("docA", "editor", 3, 1, "xy", 0)
+
+
+def test_journal_reopen_continues_seq_and_segments(tmp_path):
+    """A post-recovery journal must never reuse sequence numbers or
+    clobber existing segments."""
+    d, records = _small_journal(tmp_path)
+    top = records[-1].seq
+    jr = J.Journal(d, num_shards=1)
+    jr.admit(0, "docC")
+    jr.close()
+    records2, errors = J.scan(d)
+    assert not errors
+    assert records2[-1].seq == top + 1
+    assert records2[-1].body.decode() == "docC"
+    assert len({r.segment for r in records2}) == 2, \
+        "reopen must open a NEW segment, not append to the old one"
+
+
+def test_journal_torn_tail_truncation_sweep(tmp_path):
+    """A power cut can land mid-write at ANY byte: for every truncation
+    point inside the final record, the scanner recovers the valid
+    prefix exactly and refuses the tail with a typed error naming the
+    segment and offset."""
+    d, records = _small_journal(tmp_path)
+    last = records[-1]
+    seg = last.segment
+    size = os.path.getsize(seg)
+    assert size > last.offset
+    pristine = open(seg, "rb").read()
+    for cut in range(last.offset + 1, size):
+        with open(seg, "wb") as fh:
+            fh.write(pristine[:cut])
+        got, errors = J.scan(d)
+        assert [r.seq for r in got] == [r.seq for r in records[:-1]], \
+            f"valid prefix lost at cut={cut}"
+        assert len(errors) == 1
+        err = errors[0]
+        assert isinstance(err, J.JournalError)
+        assert err.segment == seg
+        assert err.offset == last.offset
+    # Truncation exactly at the record boundary is a clean EOF.
+    with open(seg, "wb") as fh:
+        fh.write(pristine[:last.offset])
+    got, errors = J.scan(d)
+    assert not errors and len(got) == len(records) - 1
+    with open(seg, "wb") as fh:
+        fh.write(pristine)
+
+
+def test_journal_bitflip_sweep(tmp_path):
+    """Flip one bit at every byte of the final record: the CRC chain
+    (or the framing validators) must refuse the record — never load
+    corrupt bytes, never lose the valid prefix, never crash."""
+    d, records = _small_journal(tmp_path)
+    last = records[-1]
+    seg = last.segment
+    pristine = open(seg, "rb").read()
+    for at in range(last.offset, len(pristine)):
+        mutated = bytearray(pristine)
+        mutated[at] ^= 0x01
+        with open(seg, "wb") as fh:
+            fh.write(bytes(mutated))
+        got, errors = J.scan(d)
+        assert [r.seq for r in got] == [r.seq for r in records[:-1]], \
+            f"prefix corrupted by flip at {at}"
+        assert errors, f"flip at byte {at} went undetected"
+        assert all(isinstance(e, J.JournalError) for e in errors)
+        assert errors[0].segment == seg
+    with open(seg, "wb") as fh:
+        fh.write(pristine)
+
+
+def test_journal_header_corruption_refused(tmp_path):
+    d, records = _small_journal(tmp_path)
+    seg = records[0].segment
+    pristine = open(seg, "rb").read()
+    with open(seg, "wb") as fh:
+        fh.write(b"XXXX" + pristine[4:])
+    got, errors = J.scan(d)
+    assert not got
+    assert errors and "magic" in errors[0].reason
+
+
+# -- end-to-end recovery -----------------------------------------------------
+
+
+def _journaled_run(tmp_path, **kw):
+    cfg = ServeConfig(num_shards=2, lanes_per_shard=2,
+                      journal_dir=str(tmp_path / "journal"),
+                      spool_dir=str(tmp_path / "spool"))
+    gen = ServeLoadGen(cfg=cfg, **kw)
+    report = gen.run()
+    assert report["converged"], report["mismatches"]
+    return cfg, gen
+
+
+def test_recovery_clean_shutdown_byte_identical(tmp_path):
+    """Re-executing the full input log of a COMPLETED run reproduces
+    every doc byte-for-byte — content, CRDT state digest, and the
+    control-plane wants a poll would serve."""
+    cfg, gen = _journaled_run(tmp_path, docs=6, agents_per_doc=2,
+                              ticks=6, events_per_tick=10, seed=13,
+                              fault_rate=0.10)
+    want = logical_stream_digest(gen.server)
+    cfg2 = ServeConfig(num_shards=2, lanes_per_shard=2,
+                       journal_dir=cfg.journal_dir,
+                       spool_dir=cfg.spool_dir)
+    server2 = DocServer(cfg2)
+    stats = server2.recover()
+    assert stats["refusals"] == 0
+    assert stats["docs"] == 6
+    assert stats["ops"] > 0 and stats["ticks"] > 0
+    assert logical_stream_digest(server2) == want
+    # Replay went through the normal path: the audit invariants held.
+    assert stats["shard_mismatches"] == 0
+    assert stats["local_gaps"] == 0
+    server2.close_obs()
+
+
+def test_recovery_refuses_on_nonempty_server(tmp_path):
+    cfg, gen = _journaled_run(tmp_path, docs=2, agents_per_doc=2,
+                              ticks=3, events_per_tick=6, seed=3)
+    with pytest.raises(AssertionError):
+        gen.server.recover()
+
+
+def test_recovery_without_journal_refused(tmp_path):
+    cfg = ServeConfig(num_shards=1, lanes_per_shard=2,
+                      spool_dir=str(tmp_path / "spool"))
+    server = DocServer(cfg)
+    with pytest.raises(AssertionError):
+        server.recover()
+    server.close_obs()
+
+
+def test_recovery_journal_bytes_counted(tmp_path):
+    cfg, gen = _journaled_run(tmp_path, docs=4, agents_per_doc=2,
+                              ticks=5, events_per_tick=8, seed=5,
+                              fault_rate=0.10)
+    c = gen.server.counters
+    assert c.get("journal_bytes") > 0
+    assert c.get("journal_records") > 0
+    assert c.get("journal_ops") > 0
+
+
+# -- the batcher crash-path bugfix -------------------------------------------
+
+
+class _InjectedFault(Exception):
+    """A typed mid-tick error (stands in for CodecError & friends)."""
+
+
+def test_batcher_flushes_pipeline_on_midtick_error(tmp_path):
+    """ISSUE 16 bugfix regression: a typed error raised mid-tick at
+    pipeline depth 2 must drain/sync the in-flight entries on the way
+    out — staged syncs and flow spans must not leak (the conservation
+    audit stays green), and the server must survive to finish the run."""
+    cfg = ServeConfig(num_shards=1, lanes_per_shard=4,
+                      pipeline_ticks=2, flow_sample_mod=1,
+                      spool_dir=str(tmp_path / "spool"))
+    gen = ServeLoadGen(cfg=cfg, docs=4, agents_per_doc=2, ticks=8,
+                       events_per_tick=10, seed=7, fault_rate=0.0)
+    gen.start()
+    gen.run_ticks(0, 4)
+    batcher = gen.server.batcher
+    assert batcher.effective_pipeline_ticks() >= 2, \
+        "shape too small to put the pipeline in flight"
+    real_drain = batcher._drain_doc
+
+    def dying_drain(*a, **kw):
+        raise _InjectedFault("injected mid-tick fault at depth 2")
+
+    batcher._drain_doc = dying_drain
+    with pytest.raises(_InjectedFault):
+        gen.run_tick(4)
+    # THE fix: the unwind drained the pipeline — nothing in flight.
+    assert batcher._inflight == [], \
+        "mid-tick error leaked in-flight pipeline entries"
+    batcher._drain_doc = real_drain
+    # The server survives: finish the run and hold the flow audit green.
+    gen.run_ticks(5, 8)
+    report = gen.finalize()
+    assert report["converged"], report["mismatches"]
+    assert report["flow"]["audit_ok"], report["flow"]["findings"]

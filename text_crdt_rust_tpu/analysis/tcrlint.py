@@ -349,11 +349,11 @@ class _Cache:
 
 
 def _check_modules():
-    from . import (checks_determinism, checks_pyflakes, checks_recompile,
-                   checks_schema, checks_wallclock)
+    from . import (checks_determinism, checks_exceptions, checks_pyflakes,
+                   checks_recompile, checks_schema, checks_wallclock)
 
     return (checks_wallclock, checks_determinism, checks_schema,
-            checks_recompile, checks_pyflakes)
+            checks_recompile, checks_exceptions, checks_pyflakes)
 
 
 def _summary_map(root: str) -> Dict[str, "object"]:

@@ -273,6 +273,21 @@ class ServeConfig:
     rate_capacity: int = 0          # token bucket size per agent (0 = off)
     rate_refill: int = 0            # tokens added per tick per agent
     spool_dir: Optional[str] = None  # eviction checkpoint directory
+    journal_dir: Optional[str] = None  # write-ahead op journal (ISSUE
+    #                            16): every admitted op is appended to
+    #                            per-shard CRC-chained segments here so
+    #                            DocServer.recover() can rebuild a
+    #                            crashed server byte-identically
+    #                            (checkpoint chains + journal-suffix
+    #                            replay).  None = journaling off — the
+    #                            shipped default for latency benches
+    journal_fsync_ticks: int = 1  # fsync cadence on the logical tick
+    #                            axis: segments flush every append
+    #                            (process-crash durability) and fsync
+    #                            at TICK markers every this-many ticks
+    #                            (power-loss durability).  1 = every
+    #                            tick; the recovery ledger cell prices
+    #                            the shipped cadence
     fuse_steps: bool = True    # generalized tick-stream fusion
     #                            (ops.batch.fuse_steps): typing runs /
     #                            sweeps / replaces / remote runs always

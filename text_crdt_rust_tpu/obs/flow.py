@@ -506,6 +506,141 @@ def audit_spans(table: FlowTable,
     return dups + phantoms + leaks + findings
 
 
+# -- the crash-boundary audit -------------------------------------------------
+
+
+def audit_crash_spans(pre_events, post_events,
+                      expect_terminal: bool = False) -> dict:
+    """Conservation audit ACROSS a crash/recovery boundary (ISSUE 16).
+
+    ``pre_events`` is the crashed process's flow ledger, ``post_events``
+    the recovered one's.  Recovery re-executes the journal, so a span
+    applied before the crash applies again in the new process — that is
+    *replayed*, not a duplicate; the plain ``audit_spans`` semantics
+    would misread the join.  The crash-aware invariants:
+
+    - ``crash-leak``: a span applied before the crash with no covering
+      apply after recovery — the journal lost it (this is the finding
+      the journal-record-drop injection proves loud, BEFORE resumed
+      anti-entropy can quietly heal the hole);
+    - ``duplicate-apply`` / ``local-duplicate``: within the recovered
+      process only (replay must re-apply exactly once);
+    - ``phantom-apply``: applied after recovery yet emitted in neither
+      epoch;
+    - ``leak`` (only with ``expect_terminal``, i.e. after the resumed
+      run fully drains): an emitted span with no terminal disposition
+      in the joined ledger;
+    - ``crash-local-leak``: a pre-crash local edit whose ordinal the
+      replay never re-submitted (the deterministic re-execution assigns
+      the same per-doc ``lk`` order, so the keys join exactly).
+
+    Returns ``{"audit_ok", "findings", "replayed_spans",
+    "replayed_locals"}``."""
+    pre = spans_from_events(pre_events)
+    post = spans_from_events(post_events)
+    findings: List[dict] = []
+    replayed = 0
+    empty = _AgentFlow()
+    for key in sorted(set(pre.agents) | set(post.agents)):
+        doc, agent = key
+        af_pre = pre.agents.get(key, empty)
+        af_post = post.agents.get(key, empty)
+        applied_pre = _merge([_span(ev) for ev in af_pre.applies])
+        applied_post = _merge([_span(ev) for ev in af_post.applies])
+        for s, e in applied_pre:
+            if _covered(applied_post, s, e):
+                replayed += 1
+        for s, e in _subtract(applied_pre, applied_post):
+            findings.append({
+                "kind": "crash-leak", "doc": doc, "agent": agent,
+                "seq": s, "end": e,
+                "detail": f"span ({agent!r}, {s}..{e}) was applied "
+                          f"before the crash but has no covering apply "
+                          f"after recovery — journal replay lost it"})
+        applies_post = [(*_span(ev), i)
+                        for i, ev in enumerate(af_post.applies)]
+        for ia, ib in _overlap_pairs(applies_post):
+            ea, eb = af_post.applies[ia], af_post.applies[ib]
+            s = max(_span(ea)[0], _span(eb)[0])
+            e = min(_span(ea)[1], _span(eb)[1])
+            findings.append({
+                "kind": "duplicate-apply", "doc": doc, "agent": agent,
+                "seq": s, "end": e,
+                "detail": f"span ({agent!r}, {s}..{e}) applied twice "
+                          f"inside the recovered process: tick "
+                          f"{ea['t']} and tick {eb['t']}"})
+        emitted = _merge(
+            [_span(ev) for ev in af_pre.emits]
+            + [_span(ev) for ev in af_post.emits]
+            + [_span(ev) for ev in af_pre.applies
+               if ev.get("lk") is not None]
+            + [_span(ev) for ev in af_post.applies
+               if ev.get("lk") is not None])
+        for s, e in _subtract(applied_post, emitted):
+            findings.append({
+                "kind": "phantom-apply", "doc": doc, "agent": agent,
+                "seq": s, "end": e,
+                "detail": f"span ({agent!r}, {s}..{e}) applied after "
+                          f"recovery but emitted in neither epoch"})
+        if expect_terminal:
+            rejected = _merge(
+                [_span(ev) for ev in af_pre.rejects if "seq" in ev]
+                + [_span(ev) for ev in af_post.rejects if "seq" in ev])
+            open_ranges = _subtract(_subtract(emitted, applied_post),
+                                    rejected)
+            for s, e in open_ranges:
+                loc = STAGE_LOCATION[_last_stage(af_post, s, e)]
+                findings.append({
+                    "kind": "leak", "doc": doc, "agent": agent,
+                    "seq": s, "end": e,
+                    "detail": f"span ({agent!r}, {s}..{e}) leaked "
+                              f"across the crash boundary: last seen "
+                              f"at {loc}"})
+
+    replayed_locals = 0
+    for (doc, lk), slot_pre in sorted(pre.locals.items()):
+        slot_post = post.locals.get((doc, lk))
+        pre_applied = bool(slot_pre["applies"])
+        if slot_post is None:
+            if pre_applied or expect_terminal:
+                findings.append({
+                    "kind": "crash-local-leak", "doc": doc,
+                    "detail": f"local edit lk={lk} existed before the "
+                              f"crash but replay never re-submitted "
+                              f"it"})
+            continue
+        if pre_applied and slot_post["applies"]:
+            replayed_locals += 1
+        if pre_applied and not slot_post["applies"] \
+                and slot_post["reject"] is None:
+            findings.append({
+                "kind": "crash-local-leak", "doc": doc,
+                "detail": f"local edit lk={lk} was applied before the "
+                          f"crash but is neither applied nor rejected "
+                          f"after recovery"})
+    for (doc, lk), slot_post in sorted(post.locals.items()):
+        if len(slot_post["applies"]) > 1:
+            findings.append({
+                "kind": "local-duplicate", "doc": doc,
+                "detail": f"local edit lk={lk} applied "
+                          f"{len(slot_post['applies'])} times inside "
+                          f"the recovered process"})
+        elif (doc, lk) not in pre.locals and expect_terminal \
+                and not slot_post["applies"] \
+                and slot_post["reject"] is None:
+            findings.append({
+                "kind": "local-leak", "doc": doc,
+                "detail": f"local edit lk={lk} submitted after "
+                          f"recovery, never applied or rejected"})
+    return {
+        "audit_ok": not findings,
+        "findings": findings[:16],
+        "total_findings": len(findings),
+        "replayed_spans": replayed,
+        "replayed_locals": replayed_locals,
+    }
+
+
 # -- ages ---------------------------------------------------------------------
 
 

@@ -45,7 +45,8 @@ from __future__ import annotations
 import json
 from typing import Dict, List, Tuple
 
-LEDGER_SCHEMA_VERSION = 1
+LEDGER_SCHEMA_VERSION = 2  # v2 (ISSUE 16): "recovery" metric family
+#                            (journal/replay durability counters)
 
 #: Default committed-artifact location (repo-root relative).
 LEDGER_PATH = "perf/COST_LEDGER.json"
@@ -67,6 +68,10 @@ METRIC_FAMILIES = (
     "flow",         # per-op provenance: span terminal states + op-age-
     #                 at-apply in logical ticks (obs/flow, ISSUE 11) —
     #                 the ROADMAP-7 pipelined-tick latency contract
+    "recovery",     # durability (ISSUE 16): journal bytes/op, replayed
+    #                 records/ops/ticks-to-recover of the pinned crash
+    #                 scenario, byte-identity + crash-audit asserted
+    #                 green before pinning
 )
 
 CELL_KINDS = ("cpu", "device")

@@ -28,7 +28,9 @@ Trigger classes (``REASONS``): ``codec`` (`net/codec.CodecError`),
 ``causal-gap`` (`net/session.CausalGapError`), ``checkpoint``
 (`utils/checkpoint.CheckpointError`), ``degrade`` (lane-capacity
 overflow), ``divergence`` (digest mismatch or twin/lane bit-identity
-mismatch).  Bundles are BOUNDED: the first failure of each reason
+mismatch), ``journal`` (`serve/journal.JournalError` — a refused
+journal segment at recovery), ``pipeline-flush`` (the emergency
+in-flight sync while a tick unwinds failed too).  Bundles are BOUNDED: the first failure of each reason
 class dumps, later ones are counted (``bundles_suppressed``) — a 10%
 fault-injection loadgen run must not write thousands of bundles.
 """
@@ -47,8 +49,11 @@ REASON_CAUSAL_GAP = "causal-gap"
 REASON_CHECKPOINT = "checkpoint"
 REASON_DEGRADE = "degrade"
 REASON_DIVERGENCE = "divergence"
+REASON_JOURNAL = "journal"
+REASON_PIPELINE_FLUSH = "pipeline-flush"
 REASONS = (REASON_CODEC, REASON_CAUSAL_GAP, REASON_CHECKPOINT,
-           REASON_DEGRADE, REASON_DIVERGENCE)
+           REASON_DEGRADE, REASON_DIVERGENCE, REASON_JOURNAL,
+           REASON_PIPELINE_FLUSH)
 
 # Per-process recorder ids: several servers (or a flat-twin pair in one
 # probe) may share one out_dir — e.g. the conftest TCR_TRACE_DIR

@@ -56,7 +56,9 @@ import json
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
-TRACE_SCHEMA_VERSION = 1
+TRACE_SCHEMA_VERSION = 2  # v2 (ISSUE 16): journal.segment /
+#                           journal.refuse / recovery.replay /
+#                           chaos.crash event kinds
 
 # kind -> required logical field names (beyond the envelope "i"/"t"/"k").
 # Extra fields are allowed — the schema pins the floor, not the ceiling.
@@ -82,6 +84,11 @@ EVENT_SCHEMA: Dict[str, Tuple[str, ...]] = {
     # local edits carry a per-doc ordinal ``lk`` until the oracle
     # realizes their seq at apply.  The floor requires doc+agent — seq
     # vs lk is the span-identity split the flow module owns.
+    # Durability + recovery (ISSUE 16, serve/journal.py + chaos.py).
+    "journal.segment": ("shard", "seg"),
+    "journal.refuse": ("segment", "offset", "reason"),
+    "recovery.replay": ("records", "ops", "ticks"),
+    "chaos.crash": ("phase",),
     "flow.emit": ("doc", "agent", "n"),
     "flow.frame": ("doc", "agent", "seq", "n", "frame"),
     "flow.reject": ("doc", "agent", "reason"),

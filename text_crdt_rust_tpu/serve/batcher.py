@@ -732,6 +732,14 @@ class ContinuousBatcher:
             ok, ops = (self._apply_local(doc, event, compile_device)
                        if event.kind == EV_LOCAL
                        else self._apply_txn(doc, event, compile_device))
+            if event.kind == EV_LOCAL and event.ordinal is not None:
+                # The local-edit durability watermark advances on
+                # PROCESSING, not success: a validity-dropped local
+                # consumed its ordinal and must not replay after a
+                # crash (ISSUE 16 — journal replay skips ordinals
+                # below this).
+                doc.local_applied = max(doc.local_applied,
+                                        event.ordinal + 1)
             if not ok:
                 continue
             applied.append(event)
@@ -771,7 +779,34 @@ class ContinuousBatcher:
     # -- the tick -----------------------------------------------------------
 
     def tick(self, tick_no: int) -> Dict[str, float]:
-        """One serving tick across all shards; returns tick stats."""
+        """One serving tick across all shards; returns tick stats.
+
+        A typed error escaping mid-tick (aliasing sanitizer, capacity
+        assert, an injected fault) must not strand dispatched-but-
+        unsynced pipeline entries: their staged syncs would never run,
+        leaking device work, latency stamps and flow spans — and a
+        later ``flush_pipeline`` after partial host mutations could
+        sync against torn state.  So the in-flight queue is drained
+        before the error propagates (ISSUE 16 bugfix; the regression
+        test injects a fault at depth 2 and asserts the flow audit
+        stays green)."""
+        try:
+            return self._tick_inner(tick_no)
+        except BaseException as tick_exc:
+            try:
+                self.flush_pipeline()
+            except Exception as flush_exc:
+                # The original error is the story; the flush failure is
+                # recorded, not raised over it.
+                if self.recorder is not None:
+                    self.recorder.on_failure(
+                        "pipeline-flush",
+                        f"flush_pipeline failed while unwinding "
+                        f"tick {tick_no}: {flush_exc} "
+                        f"(original: {tick_exc})")
+            raise
+
+    def _tick_inner(self, tick_no: int) -> Dict[str, float]:
         t0 = time.perf_counter()
         tr = self.tracer
         if tr is not None:

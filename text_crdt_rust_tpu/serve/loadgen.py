@@ -187,7 +187,8 @@ class ServeLoadGen:
                  local_prob: float = 0.25, seed: int = 7,
                  cfg: Optional[ServeConfig] = None,
                  resync_every: int = 4, verbose: bool = False,
-                 workload: str = "scatter"):
+                 workload: str = "scatter", byzantine: float = 0.0,
+                 flash_crowd: Optional[Tuple[int, int]] = None):
         self.rng = random.Random(seed)
         self.cfg = cfg or ServeConfig()
         self.server = DocServer(self.cfg)
@@ -219,6 +220,20 @@ class ServeLoadGen:
         self.mux_channel = FaultyChannel(spec=spec, seed=seed * 7919 + 1)
         # Zipf popularity over docs (rank 0 hottest).
         self.weights = [1.0 / (i + 1) ** zipf_alpha for i in range(docs)]
+        # Byzantine agent class (ISSUE 16 satellite): rate of hostile
+        # frames per tick relative to events_per_tick.  Every hostile
+        # frame must be refused TYPED (or absorbed as a dup) — any
+        # other exception escaping the submit surface is a panic, and
+        # the seeded test treats it as a failure.
+        self.byzantine = max(0.0, float(byzantine))
+        self.byz_rng = random.Random(seed * 104729 + 13)
+        self.byz_sent = 0
+        self.byz_rejected = 0
+        self.byz_absorbed = 0
+        # Flash-crowd scenario (ISSUE 16 satellite): from tick T on,
+        # the pick distribution collapses onto one hot doc — lane
+        # overflow + residency thrash on a single key.
+        self.flash_crowd = flash_crowd
         self.rejections = 0
         self.ops_offered = 0
         # Wire accounting: bytes handed to the transport (pre-fault,
@@ -378,6 +393,56 @@ class ServeLoadGen:
         self._ship_mux(owed_batches, faulty=faulty, lane="pull")
         return wanting
 
+    def _ship_byzantine(self, tick_index: int) -> None:
+        """The byzantine agent class: a seeded stream of hostile frames
+        — garbage bytes, bit-flipped frames, truncations, replays of
+        already-delivered history, unknown-doc and wrong-lane
+        submissions.  The server contract under attack: every hostile
+        frame is either refused with a TYPED ``AdmissionError`` (counted
+        below) or absorbed as a no-op duplicate — nothing panics the
+        tick loop, nothing corrupts convergence.  Runs off its own rng
+        so enabling the attacker never shifts the legitimate traffic
+        stream (the crash-twin comparisons depend on that)."""
+        rng = self.byz_rng
+        n = max(1, round(self.events_per_tick * self.byzantine))
+        for _ in range(n):
+            attack = rng.choice(("garbage", "bitflip", "truncate",
+                                 "replay", "unknown-doc", "wrong-lane"))
+            world = self.worlds[rng.randrange(len(self.worlds))]
+            doc_id = world.doc_id
+            data: Optional[bytes] = None
+            if attack == "garbage":
+                data = bytes(rng.randrange(256)
+                             for _ in range(rng.randint(1, 40)))
+            elif attack in ("bitflip", "truncate", "replay"):
+                if not world.txns:
+                    continue  # nothing delivered yet to mangle/replay
+                upto = rng.randint(1, min(4, len(world.txns)))
+                frame = bytearray(codec.encode_txns(world.txns[:upto]))
+                if attack == "bitflip":
+                    frame[rng.randrange(len(frame))] ^= \
+                        1 << rng.randrange(8)
+                elif attack == "truncate":
+                    del frame[rng.randint(1, len(frame) - 1):]
+                data = bytes(frame)
+            elif attack == "unknown-doc":
+                doc_id = f"byz-doc-{rng.randrange(1 << 16):04x}"
+                data = codec.encode_txns(world.txns[:1]) \
+                    if world.txns else b"\x00"
+            else:  # wrong-lane: a mux frame on the per-doc lane
+                if not world.txns:
+                    continue
+                data = columnar.encode_mux([(doc_id, world.txns[:1])])
+            self.byz_sent += 1
+            try:
+                self.server.submit_frame(doc_id, data)
+            except AdmissionError:
+                self.byz_rejected += 1
+            else:
+                # Replays (and garbage that happened to parse as a
+                # benign frame) land here: absorbed, state untouched.
+                self.byz_absorbed += 1
+
     def _observe_server_edits(self) -> None:
         """Feed the twins whatever new history the server produced
         (its own local edits, interleaved with merges)."""
@@ -396,6 +461,14 @@ class ServeLoadGen:
         picks = self.rng.choices(range(len(self.worlds)),
                                  weights=self.weights,
                                  k=self.events_per_tick)
+        if (self.flash_crowd is not None
+                and tick_index >= self.flash_crowd[0]):
+            # Flash crowd: 90% of this tick's events slam one doc.  The
+            # remap consumes its own rng draws AFTER the base picks so
+            # pre-flash ticks are byte-identical to the plain run.
+            hot = self.flash_crowd[1] % len(self.worlds)
+            picks = [hot if self.rng.random() < 0.90 else p
+                     for p in picks]
         for d in picks:
             world = self.worlds[d]
             if self.rng.random() < self.local_prob:
@@ -441,6 +514,8 @@ class ServeLoadGen:
                     world.outbox.extend(fresh)
                 else:
                     self._ship(world, agent, txns, faulty=True)
+        if self.byzantine > 0.0:
+            self._ship_byzantine(tick_index)
         if self.wire == "columnar":
             # The Nagle window is checked every tick (ISSUE 12): the
             # flush cadence is the window's own, decoupled from the
@@ -462,25 +537,47 @@ class ServeLoadGen:
     # -- the full run --------------------------------------------------------
 
     def run(self) -> Dict[str, object]:
-        t0 = time.perf_counter()
-        applied = 0
-        steps = 0
-        for i in range(self.ticks):
+        self.start()
+        self.run_ticks(0, self.ticks)
+        return self.finalize()
+
+    def start(self) -> None:
+        """Arm the run clock and accumulators.  ``run()`` calls this;
+        the chaos harness calls it once, then drives ``run_ticks`` in
+        pieces around the injected crash."""
+        self._t0 = time.perf_counter()
+        self._applied = 0
+        self._steps = 0
+
+    def run_ticks(self, start: int, stop: int) -> None:
+        """Drive ticks ``start..stop`` (half-open).  Resumable: the
+        crash harness runs ``[0, k)``, kills and recovers the server,
+        then runs ``[k+1, ticks)`` against the recovered instance —
+        generation state (worlds, rng, fault channels) lives here and
+        survives the server's death, exactly like real clients would."""
+        for i in range(start, stop):
             stats = self.run_tick(i)
-            applied += stats["ops_applied"]
-            steps += stats["steps"]
+            self._applied += stats["ops_applied"]
+            self._steps += stats["steps"]
             if self.verbose and (i + 1) % 10 == 0:
                 rc = self.server.residency.resident_counts()
-                print(f"tick {i + 1}/{self.ticks}: applied {applied} "
-                      f"item-ops, {rc['docs_in_lane']} in-lane / "
-                      f"{rc['docs_evicted']} evicted", flush=True)
+                print(f"tick {i + 1}/{self.ticks}: applied "
+                      f"{self._applied} item-ops, {rc['docs_in_lane']} "
+                      f"in-lane / {rc['docs_evicted']} evicted",
+                      flush=True)
+
+    def finalize(self) -> Dict[str, object]:
+        """The run tail: flush the pipeline, drain the anti-entropy
+        cycle clean, verify every doc against its twin, and assemble
+        the report."""
+        applied = self._applied
         # The timed loop is not done until its device work is: flush
         # the pipeline BEFORE the wall capture, so serial and pipelined
         # arms account identical work (a depth-D run would otherwise
         # push its last D-1 ticks' sync cost outside the loop wall and
         # bias the probe's regression gate in its own favor).
         self.server.flush_pipeline()
-        loop_wall = time.perf_counter() - t0
+        loop_wall = time.perf_counter() - self._t0
 
         # Final drain: clean digests + re-delivery until the server owes
         # no REQUESTs and every queue is empty — the anti-entropy cycle
@@ -499,7 +596,7 @@ class ServeLoadGen:
         self._observe_server_edits()
 
         converged, mismatches = self.verify()
-        wall = time.perf_counter() - t0
+        wall = time.perf_counter() - self._t0
         stats = self.server.stats()
         tick_sum = self.server.tick_summary()
         report = {
@@ -512,6 +609,12 @@ class ServeLoadGen:
             "drain_rounds": drain_rounds,
             "wall_s": round(wall, 3),
             "rejected_submissions": self.rejections,
+            "byzantine": {
+                "rate": self.byzantine,
+                "sent": self.byz_sent,
+                "rejected": self.byz_rejected,
+                "absorbed": self.byz_absorbed,
+            },
             "latency_us": self.server.latency_summary(),
             "tick_ms": tick_sum,
             "engine": self.cfg.engine,
@@ -732,13 +835,71 @@ def main(argv=None) -> None:
     ap.add_argument("--profile-dir", default=None,
                     help="opt-in jax.profiler capture directory "
                          "(ticks 1..profile_ticks)")
+    ap.add_argument("--journal-dir", default=None,
+                    help="write-ahead op journal directory (ISSUE 16): "
+                         "every admitted input is logged before it can "
+                         "mutate state; a crashed server recovers by "
+                         "re-executing the log")
+    ap.add_argument("--journal-fsync-ticks", type=int,
+                    default=d.journal_fsync_ticks,
+                    help="fsync the journal every N logical ticks "
+                         "(1 = every tick boundary)")
+    ap.add_argument("--byzantine", type=float, default=0.0,
+                    metavar="RATE",
+                    help="byzantine agent class: ship this many "
+                         "malformed/corrupt/replayed frames per tick "
+                         "(fraction of events-per-tick); every one "
+                         "must be refused typed or absorbed as a dup, "
+                         "never panic the tick loop")
+    ap.add_argument("--flash-crowd", default=None, metavar="TICK:DOC",
+                    help="from tick TICK on, remap 90%% of each tick's "
+                         "events onto doc index DOC — lane overflow + "
+                         "residency thrash on one hot doc")
+    ap.add_argument("--crash-at", default=None, metavar="PHASE:TICK",
+                    help="crash-injection harness (serve/chaos): kill "
+                         "the server at the named phase of loadgen "
+                         "tick TICK, recover from the journal, resume, "
+                         "and compare logical streams against an "
+                         "uncrashed same-seed twin. Phases: post-admit, "
+                         "post-dispatch, mid-ckpt, mid-journal")
     ap.add_argument("--verbose", action="store_true")
     a = ap.parse_args(argv)
+
+    flash_crowd = None
+    if a.flash_crowd is not None:
+        tick_s, _, doc_s = a.flash_crowd.partition(":")
+        flash_crowd = (int(tick_s), int(doc_s))
 
     import jax
 
     if not a.device:
         jax.config.update("jax_platforms", "cpu")
+
+    if a.crash_at is not None:
+        # The chaos harness owns the whole run (victim, recovery,
+        # resume, twin); it needs a journal, and allocates its own
+        # workdir when --journal-dir is not given.
+        from .chaos import PHASES, run_crash_scenario
+        phase, _, tick_s = a.crash_at.partition(":")
+        if phase not in PHASES or not tick_s:
+            raise SystemExit(f"--crash-at wants PHASE:TICK with PHASE in "
+                             f"{PHASES}, got {a.crash_at!r}")
+        cell = run_crash_scenario(
+            phase, int(tick_s), ticks=a.ticks, docs=a.docs,
+            agents_per_doc=a.agents, events_per_tick=a.events_per_tick,
+            seed=a.seed, fault_rate=a.fault_rate, num_shards=a.shards,
+            lanes_per_shard=a.lanes, ckpt_format=a.ckpt,
+            fsync_ticks=a.journal_fsync_ticks, byzantine=a.byzantine,
+            flash_crowd=flash_crowd)
+        import json
+
+        cell.pop("report")
+        print(json.dumps(cell, indent=1, default=str))
+        ok = (cell["identical"] and cell["converged"]
+              and cell["at_recovery_audit"]["audit_ok"]
+              and cell["final_audit"]["audit_ok"])
+        raise SystemExit(0 if ok else 1)
+
     cfg = ServeConfig(engine=a.engine, num_shards=a.shards,
                       lanes_per_shard=a.lanes,
                       wire_format=a.wire, ckpt_format=a.ckpt,
@@ -750,12 +911,15 @@ def main(argv=None) -> None:
                       trace=not a.no_trace, trace_path=a.trace_path,
                       trace_rotate_bytes=a.trace_rotate_bytes,
                       flow_sample_mod=a.flow_sample_mod,
-                      profile_dir=a.profile_dir)
+                      profile_dir=a.profile_dir,
+                      journal_dir=a.journal_dir,
+                      journal_fsync_ticks=a.journal_fsync_ticks)
     gen = ServeLoadGen(docs=a.docs, agents_per_doc=a.agents, ticks=a.ticks,
                        events_per_tick=a.events_per_tick, zipf_alpha=a.zipf,
                        fault_rate=a.fault_rate, local_prob=a.local_prob,
                        seed=a.seed, cfg=cfg, verbose=a.verbose,
-                       workload=a.workload)
+                       workload=a.workload, byzantine=a.byzantine,
+                       flash_crowd=flash_crowd)
     report = gen.run()
     import json
 

@@ -75,6 +75,11 @@ class LaneResidency:
             [None] * b.lanes for b in backends
         ]
         self._ckpt_ids: Dict[str, int] = {}
+        # Monotonic file-number allocator, NOT len(_ckpt_ids): after a
+        # crash recovery that refused a corrupt checkpoint, the refused
+        # file's number must stay burned or a new doc would collide
+        # with a surviving doc's files.
+        self._next_ckpt_id = 0
 
     # -- introspection -------------------------------------------------------
 
@@ -93,7 +98,8 @@ class LaneResidency:
     def _ckpt_path(self, doc_id: str) -> str:
         # Stable, filesystem-safe name per doc (ids are arbitrary strings).
         if doc_id not in self._ckpt_ids:
-            self._ckpt_ids[doc_id] = len(self._ckpt_ids)
+            self._ckpt_ids[doc_id] = self._next_ckpt_id
+            self._next_ckpt_id += 1
         return os.path.join(self.spool_dir,
                             f"doc_{self._ckpt_ids[doc_id]:06d}.npz")
 
@@ -187,6 +193,13 @@ class LaneResidency:
         # (router.poll_request_frame reads known_marks).
         doc.absorb_oracle_marks()
         t_io = time.perf_counter()
+        # Extra meta rides every save (ISSUE 16): the doc id maps files
+        # back to docs when recovery rediscovers checkpoints from disk
+        # (``_ckpt_ids`` died with the process), and ``local_applied``
+        # is the local-edit replay watermark — written atomically with
+        # the oracle state it describes.
+        extra = {"doc_id": doc.doc_id,
+                 "local_applied": doc.local_applied}
         if self.ckpt_format == "delta":
             chain = self._chains.get(doc.doc_id)
             if chain is None:
@@ -194,10 +207,10 @@ class LaneResidency:
                     path[:-len(".npz")],
                     compact_ops=self.ckpt_compact_ops,
                     compact_links=self.ckpt_compact_links)
-            info = chain.save(doc.oracle)
+            info = chain.save(doc.oracle, extra_meta=extra)
             path = chain.base_path
         else:
-            info = checkpoint.save_doc(doc.oracle, path)
+            info = checkpoint.save_doc(doc.oracle, path, extra_meta=extra)
             info = {"kind": "full", "bytes": info["bytes"]}
         io_ms = (time.perf_counter() - t_io) * 1e3
         self.counters.incr(f"ckpt_saves_{info['kind']}")
@@ -288,6 +301,82 @@ class LaneResidency:
                               n=oracle.n,
                               orders=oracle.get_next_order(),
                               wall=wall)
+
+    # -- crash recovery (ISSUE 16) ------------------------------------------
+
+    def rediscover(self) -> Dict[str, dict]:
+        """Audit the spool directory after a crash and advance the
+        checkpoint-file allocator past everything on disk.
+
+        Returns ``doc_id -> {"path", "local_applied"}`` for every doc
+        with a LOADABLE checkpoint (chains validated link by link: a
+        corrupt tail link truncates its chain, a corrupt BASE or full
+        snapshot refuses the whole doc's checkpoint — each counted,
+        traced, recorded).  Nothing is REGISTERED: recovery re-executes
+        the journal from genesis, so replayed evictions lay down fresh
+        checkpoint files — registering a crash-time chain here would
+        hand a replayed (earlier-order) evict a tip from its own
+        future.  Pre-crash files survive untouched for forensics; the
+        advanced ``_next_ckpt_id`` keeps fresh files clear of them,
+        refused numbers included."""
+        found: Dict[str, dict] = {}
+        names = sorted(os.listdir(self.spool_dir))
+        for name in names:
+            if not (name.startswith("doc_") and name.endswith(".npz")):
+                continue
+            is_base = name.endswith(".base.npz")
+            if self.ckpt_format == "delta":
+                if not is_base:
+                    continue  # delta links walk with their base
+                file_no = int(name[len("doc_"):-len(".base.npz")])
+                stem = os.path.join(self.spool_dir,
+                                    name[:-len(".base.npz")])
+                path = stem + ".base.npz"
+            else:
+                if is_base or ".d" in name:
+                    continue  # stale delta files under full format
+                file_no = int(name[len("doc_"):-len(".npz")])
+                path = os.path.join(self.spool_dir, name)
+            self._next_ckpt_id = max(self._next_ckpt_id, file_no + 1)
+            try:
+                if self.ckpt_format == "delta":
+                    _chain, dropped, tip_meta = \
+                        checkpoint.CheckpointChain.from_disk(
+                            stem, compact_ops=self.ckpt_compact_ops,
+                            compact_links=self.ckpt_compact_links)
+                    for link_path in dropped:
+                        self.counters.incr("recovery_ckpt_links_refused")
+                        if self.tracer is not None:
+                            self.tracer.event(
+                                "residency.restore", doc=None,
+                                error=f"refused chain link {link_path}")
+                else:
+                    tip_meta, _ = checkpoint._load_npz(
+                        path, expect_kind="oracle")
+            except checkpoint.CheckpointError as e:
+                self.counters.incr("recovery_ckpt_refused")
+                if self.tracer is not None:
+                    self.tracer.event("residency.restore", doc=None,
+                                      error=str(e))
+                if self.recorder is not None:
+                    self.recorder.on_failure("checkpoint", str(e),
+                                             doc_id=None)
+                continue
+            doc_id = tip_meta.get("doc_id")
+            if not isinstance(doc_id, str):
+                # Pre-durability checkpoint without the doc-id meta:
+                # unmappable, refuse it loudly rather than guess.
+                self.counters.incr("recovery_ckpt_refused")
+                if self.tracer is not None:
+                    self.tracer.event(
+                        "residency.restore", doc=None,
+                        error=f"checkpoint {path} carries no doc_id meta")
+                continue
+            found[doc_id] = {
+                "path": path,
+                "local_applied": int(tip_meta.get("local_applied", 0)),
+            }
+        return found
 
     # -- verification --------------------------------------------------------
 

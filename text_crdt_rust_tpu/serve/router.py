@@ -62,14 +62,22 @@ class Event:
     applies it); ``span`` is filled by the batcher at apply time with
     the realized ``(agent, seq, n)`` so the tick can stamp the span's
     terminal ``flow.apply`` after the lane-capacity probe decides
-    device vs host."""
+    device vs host.
+
+    ``ordinal`` is a LOCAL edit's per-doc durability ordinal (ISSUE
+    16): assigned densely at admission, advanced into
+    ``DocState.local_applied`` when the batcher processes the event —
+    the watermark that makes journal replay of local edits
+    exactly-once (a validity-dropped local leaves no oracle state, so
+    no oracle-derived watermark could cover it)."""
 
     __slots__ = ("kind", "payload", "items", "t_submit", "tick_submit",
-                 "lk", "span")
+                 "lk", "span", "ordinal")
 
     def __init__(self, kind: str, payload, items: int, tick: int,
                  t_submit: Optional[float] = None,
-                 lk: Optional[int] = None):
+                 lk: Optional[int] = None,
+                 ordinal: Optional[int] = None):
         self.kind = kind
         self.payload = payload
         self.items = items
@@ -78,6 +86,7 @@ class Event:
         self.tick_submit = tick
         self.lk = lk
         self.span = None
+        self.ordinal = ordinal
 
 
 class DocState:
@@ -115,6 +124,13 @@ class DocState:
         self.degrade_reason = ""
         self.last_touch_tick = 0
         self.divergence_detected = False
+        # Local-edit durability watermarks (ISSUE 16): ``local_seen`` is
+        # the next ordinal to assign at submit; ``local_applied`` counts
+        # ordinals the batcher has PROCESSED (applied or
+        # validity-dropped).  ``local_applied`` rides checkpoint extra
+        # meta so recovery replays each journaled local exactly once.
+        self.local_seen = 0
+        self.local_applied = 0
 
     def absorb_oracle_marks(self) -> None:
         """Fold the resident oracle's per-agent watermarks into
@@ -160,6 +176,8 @@ class ShardRouter:
         self.tracer = tracer
         self.flow = flow  # obs/flow.FlowTracker (None = provenance off)
         self.recorder = None  # set by DocServer after construction
+        self.journal = None   # serve/journal.Journal (set by DocServer;
+        #                       None = durability off)
         self.buffer_max_pending = buffer_max_pending
         # TXNS frames the router EMITS (serving REQUEST pulls); decode
         # always negotiates on the version byte, so what peers send is
@@ -194,6 +212,11 @@ class ShardRouter:
         self.docs[doc_id] = doc
         self._shard_docs[shard] += 1
         self.counters.incr("docs_admitted")
+        if self.journal is not None:
+            # Admission ORDER is durable state: replaying admits in
+            # sequence reproduces both the least-loaded shard choice
+            # and the docs-dict iteration order the drain loop walks.
+            self.journal.admit(shard, doc_id)
         return doc
 
     def doc(self, doc_id: str) -> DocState:
@@ -268,7 +291,8 @@ class ShardRouter:
         except AdmissionError as e:
             self._flow_reject_txns(doc_id, [txn], e.reason)
             raise
-        self._ingest_txn(doc, txn)
+        if self._ingest_txn(doc, txn) and self.journal is not None:
+            self.journal.txns(doc.shard, doc_id, [txn])
 
     def _flow_reject_txns(self, doc_id: Optional[str],
                           txns: List[RemoteTxn], reason: str) -> None:
@@ -282,7 +306,12 @@ class ShardRouter:
             self.flow.rejected(doc_id, t.id.agent, reason,
                                seq=t.id.seq, n=txn_len(t))
 
-    def _ingest_txn(self, doc: DocState, txn: RemoteTxn) -> None:
+    def _ingest_txn(self, doc: DocState, txn: RemoteTxn) -> bool:
+        """Offer one admitted txn to the doc's causal buffer; returns
+        True when the buffer took it as FRESH (anything but a full
+        duplicate) — the predicate the journal records on (dup
+        deliveries are no-ops on buffer state, so replay skipping them
+        reproduces the same trajectory for a fraction of the bytes)."""
         doc.submit_stamps.setdefault((txn.id.agent, txn.id.seq),
                                      time.perf_counter())
         self._prune_stamps(doc)
@@ -292,6 +321,7 @@ class ShardRouter:
             self.flow.buffered(doc.doc_id, txn, "held")
         doc.last_touch_tick = self._tick
         self.enqueue_released(doc, released)
+        return doc.buffer.last_offer != "dup"
 
     def submit_local(self, doc_id: str, agent: str, pos: int,
                      del_len: int = 0, ins_content: str = "") -> None:
@@ -311,9 +341,14 @@ class ShardRouter:
             if lk is not None:
                 self.flow.rejected(doc_id, agent, e.reason, lk=lk)
             raise
+        ordinal = doc.local_seen
+        doc.local_seen += 1
+        if self.journal is not None:
+            self.journal.local(doc.shard, doc_id, agent, pos, del_len,
+                               ins_content, ordinal)
         self._enqueue(doc, Event(EV_LOCAL, (agent, pos, del_len,
                                             ins_content), items,
-                                 self._tick, lk=lk))
+                                 self._tick, lk=lk, ordinal=ordinal))
 
     def submit_frame(self, doc_id: str, data: bytes) -> List[bytes]:
         """Ingest one wire frame for ``doc_id``; returns response frames
@@ -331,6 +366,15 @@ class ShardRouter:
                 str(e), doc=doc_id, agent=e.agent, seq=e.seq,
                 n=e.n) from None
         self.counters.incr("frames_received")
+        if (self.journal is not None
+                and kind not in (codec.KIND_TXNS, codec.KIND_TXNS_MUX)):
+            # Control frames steer trajectory-relevant state (REQUEST
+            # touches the residency LRU clock, DIGEST advances
+            # peer_marks) — the input log carries them verbatim so
+            # recovery's re-execution stays exact.  TXNS frames are
+            # journaled dedup'd below instead; a mux frame on this
+            # lane is refused before it can mutate anything.
+            self.journal.frame(doc.shard, doc_id, data)
 
         if kind == codec.KIND_TXNS:
             if self.flow is not None:
@@ -351,9 +395,13 @@ class ShardRouter:
             except AdmissionError as e:
                 self._flow_reject_txns(doc_id, value, e.reason)
                 raise
+            fresh = []
             for txn in value:
                 self.admission.count_admitted(txn_len(txn))
-                self._ingest_txn(doc, txn)
+                if self._ingest_txn(doc, txn):
+                    fresh.append(txn)
+            if fresh and self.journal is not None:
+                self.journal.txns(doc.shard, doc_id, fresh)
             return []
 
         if kind == codec.KIND_REQUEST:
@@ -459,9 +507,13 @@ class ShardRouter:
                 self._flow_reject_txns(doc_id, txns, e.reason)
                 rejected.append((doc_id, str(e)))
                 continue
+            fresh = []
             for txn in txns:
                 self.admission.count_admitted(txn_len(txn))
-                self._ingest_txn(doc, txn)
+                if self._ingest_txn(doc, txn):
+                    fresh.append(txn)
+            if fresh and self.journal is not None:
+                self.journal.txns(doc.shard, doc_id, fresh)
         return rejected
 
     # -- pull / export surface ---------------------------------------------
@@ -472,6 +524,11 @@ class ShardRouter:
         corrupted frames) PLUS gaps only peer digests reveal (an agent
         whose every frame was lost). None when nothing is missing."""
         doc = self.doc(doc_id)
+        if self.journal is not None:
+            # A poll is an input, not a pure read: absorb_oracle_marks
+            # below folds the oracle's watermarks into known_marks,
+            # which narrows every later REQUEST the doc emits.
+            self.journal.poll(doc.shard, doc_id)
         wants: Dict[str, int] = {}
         for rid in doc.buffer.missing():
             wants[rid.agent] = min(wants.get(rid.agent, rid.seq), rid.seq)

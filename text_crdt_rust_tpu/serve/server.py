@@ -87,6 +87,17 @@ class DocServer:
                                        ckpt_compact_ops=cfg.ckpt_compact_ops,
                                        ckpt_compact_links=cfg.ckpt_compact_links,
                                        tracer=self.tracer)
+        # Write-ahead op journal (ISSUE 16): admission-edge durability.
+        # None (the default) = off; the loadgen/chaos drivers pin a
+        # directory so DocServer.recover() can rebuild this server.
+        self.journal = None
+        if cfg.journal_dir:
+            from .journal import Journal
+            self.journal = Journal(cfg.journal_dir, cfg.num_shards,
+                                   fsync_ticks=cfg.journal_fsync_ticks,
+                                   counters=self.counters,
+                                   tracer=self.tracer)
+            self.router.journal = self.journal
         # Flight recorder: bundles land in cfg.obs_dir, else the
         # TCR_TRACE_DIR env knob (how a failing tier-1 serve test
         # attaches its post-mortem to the pytest report — conftest),
@@ -143,7 +154,14 @@ class DocServer:
         self.tick_no += 1
         self.router.set_tick(self.tick_no)
         self._profile_hook()
-        return self.batcher.tick(self.tick_no)
+        stats = self.batcher.tick(self.tick_no)
+        if self.journal is not None:
+            # The tick boundary is the journal's fsync point AND the
+            # replay pacing marker: recovery re-runs ``tick()`` here so
+            # the apply cadence (and with it the local-vs-remote
+            # interleaving) reproduces exactly.
+            self.journal.tick(self.tick_no)
+        return stats
 
     def flush_pipeline(self) -> None:
         """Sync every in-flight pipelined tick (no-op in the serial
@@ -172,6 +190,8 @@ class DocServer:
                 self.tracer.event("profile", action="error",
                                   err=f"{type(e).__name__}: {e}")
             self._profiling = False
+        if self.journal is not None:
+            self.journal.close()
         self.tracer.close()
 
     def _profile_hook(self) -> None:
@@ -200,6 +220,133 @@ class DocServer:
             self.counters.incr("profile_errors")
             self.tracer.event("profile", action="error",
                               err=f"{type(e).__name__}: {e}")
+
+    def recover(self) -> Dict[str, int]:
+        """Rebuild a crashed server by re-executing its input log
+        (ISSUE 16 tentpole, part 2).  Call on a FRESH server
+        constructed with the dead server's ``spool_dir``/``journal_dir``.
+
+        The server is a deterministic state machine, so recovery is
+        re-execution: scan the journal (valid prefix per shard, typed
+        refusals counted + traced), audit the checkpoint spool
+        (corruption reported, file allocator advanced past the crashed
+        process's files), then replay the merged record stream through
+        the NORMAL admission -> buffer -> batcher path with journaling
+        suspended.  ADMIT records reproduce shard assignment and drain
+        order; TXNS/LOCAL/FRAME/POLL records re-submit the same inputs;
+        TICK markers re-run ``tick()`` so the apply cadence — residency
+        trajectory, local-edit position resolution, and the in-flight
+        pipelined ticks that were dispatched but never synced at crash
+        time — re-derives exactly.  Replayed evictions lay the
+        checkpoint chains down again (fresh files; the crashed
+        process's spool stays untouched for forensics), and replayed
+        restores read them back — the checkpoint path exercises itself.
+        Returns replay stats."""
+        from ..net import codec
+        from . import journal as J
+        from .admission import AdmissionError
+
+        assert self.journal is not None, \
+            "recover() needs cfg.journal_dir (durability was off)"
+        assert not self.router.docs, \
+            "recover() must run on a fresh server, before any traffic"
+        records, errors = J.scan(self.cfg.journal_dir)
+        for err in errors:
+            self.counters.incr("journal_refusals")
+            self.tracer.event("journal.refuse", segment=err.segment,
+                              offset=err.offset, reason=err.reason)
+            if self.recorder is not None:
+                self.recorder.on_failure("journal", str(err))
+        found = self.residency.rediscover()
+        stats = {"records": len(records), "refusals": len(errors),
+                 "docs": 0, "ckpts_found": len(found), "ops": 0,
+                 "txns_replayed": 0, "locals_replayed": 0,
+                 "frames_replayed": 0, "polls_replayed": 0,
+                 "ticks": 0, "readmissions": 0, "shard_mismatches": 0,
+                 "local_gaps": 0}
+        with self.journal.suspend():
+            for rec in records:
+                if rec.kind == J.REC_ADMIT:
+                    doc_id = rec.body.decode("utf-8")
+                    doc = self.router.admit_doc(doc_id)
+                    stats["docs"] += 1
+                    if doc.shard != rec.shard:
+                        # Replayed least-loaded choice disagreeing with
+                        # the recorded one would reorder every later
+                        # drain — loud, never silent.
+                        stats["shard_mismatches"] += 1
+                        self.counters.incr("recovery_shard_mismatches")
+                elif rec.kind == J.REC_TXNS:
+                    try:
+                        kind, groups, _, _ = codec.decode_frame_ex(
+                            bytes(rec.body))
+                        assert kind == codec.KIND_TXNS_MUX
+                    except codec.CodecError as e:
+                        # CRC-chained records should never decode dirty;
+                        # if one does, refuse it loudly and keep going.
+                        self.counters.incr("journal_refusals")
+                        self.tracer.event(
+                            "journal.refuse", segment=rec.segment,
+                            offset=rec.offset,
+                            reason=f"undecodable TXNS body: {e}")
+                        continue
+                    for doc_id, txns in groups:
+                        for txn in txns:
+                            try:
+                                self.router.submit_txn(doc_id, txn)
+                            except AdmissionError:
+                                stats["readmissions"] += 1
+                                continue
+                            stats["txns_replayed"] += 1
+                elif rec.kind == J.REC_LOCAL:
+                    (doc_id, agent, pos, del_len, ins,
+                     ordinal) = J.decode_local_body(rec.body)
+                    doc = self.router.doc(doc_id)
+                    if ordinal != doc.local_seen:
+                        # Exactly-once audit: the rebuilt ordinal
+                        # counter must agree with the recorded one.
+                        stats["local_gaps"] += 1
+                        self.counters.incr("recovery_local_gaps")
+                    try:
+                        self.router.submit_local(doc_id, agent, pos,
+                                                 del_len, ins)
+                    except AdmissionError:
+                        stats["readmissions"] += 1
+                        continue
+                    stats["locals_replayed"] += 1
+                elif rec.kind == J.REC_FRAME:
+                    doc_id, data = J.decode_frame_body(rec.body)
+                    try:
+                        self.router.submit_frame(doc_id, data)
+                    except AdmissionError:
+                        stats["readmissions"] += 1
+                        continue
+                    stats["frames_replayed"] += 1
+                elif rec.kind == J.REC_POLL:
+                    doc_id = rec.body.decode("utf-8")
+                    try:
+                        self.router.poll_request_frame(doc_id)
+                    except AdmissionError:
+                        stats["readmissions"] += 1
+                        continue
+                    stats["polls_replayed"] += 1
+                elif rec.kind == J.REC_TICK:
+                    tick_no, _ = J._read_varint(rec.body, 0,
+                                                len(rec.body))
+                    if tick_no <= self.tick_no:
+                        continue  # one marker per shard: replay once
+                    self.tick_no = tick_no - 1
+                    self.tick()
+                    stats["ticks"] += 1
+        stats["ops"] = stats["txns_replayed"] + stats["locals_replayed"]
+        self.counters.incr("recovery_ops_replayed", stats["ops"])
+        self.counters.incr("recovery_ticks", stats["ticks"])
+        self.tracer.event("recovery.replay", records=stats["records"],
+                          ops=stats["ops"], ticks=stats["ticks"],
+                          docs=stats["docs"],
+                          ckpts=stats["ckpts_found"],
+                          refusals=stats["refusals"])
+        return stats
 
     def drain(self, max_ticks: int = 64) -> int:
         """Tick until every queue is empty (or the budget runs out);

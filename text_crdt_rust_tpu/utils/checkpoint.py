@@ -140,11 +140,17 @@ def _load_npz(path: str, expect_kind: str):
     return meta, arrays
 
 
-def save_doc(doc, path: str) -> dict:
+def save_doc(doc, path: str, extra_meta: Optional[dict] = None) -> dict:
     """Serialize an oracle ``ListCRDT`` to ``path`` (.npz).
 
     Returns ``{"crc", "next_order", "bytes"}`` — what a delta chain
-    needs to reference this file as its base."""
+    needs to reference this file as its base.
+
+    ``extra_meta`` rides in the JSON header under caller-chosen keys
+    (the serve tier stores its doc id and local-edit replay watermark
+    there).  Loads ignore unknown meta keys, so extra meta is
+    backward- and forward-compatible without a FORMAT_VERSION bump;
+    core keys cannot be overridden."""
     n = doc.n
     cwo = list(doc.client_with_order)
     deletes = list(doc.deletes)
@@ -158,13 +164,14 @@ def save_doc(doc, path: str) -> dict:
     parents = [
         (i, p) for i, t in enumerate(txns) for p in t.parents
     ]
-    meta = {
+    meta = dict(extra_meta or {})
+    meta.update({
         "version": FORMAT_VERSION,
         "kind": "oracle",
         "agents": [cd.name for cd in doc.client_data],
         "n": n,
         "next_order": doc.get_next_order(),
-    }
+    })
     arrays = dict(
         order=doc.order[:n],
         origin_left=doc.origin_left[:n],
@@ -254,7 +261,7 @@ def _rebuild_oracle(z, n: int, agents):
 # -- incremental (delta) checkpoints -----------------------------------------
 
 def save_delta(doc, path: str, *, base_crc: int, prev_crc: int,
-               from_order: int) -> dict:
+               from_order: int, extra_meta: Optional[dict] = None) -> dict:
     """Write the history ``from_order..`` as one delta link at ``path``.
 
     ``prev_crc`` names the immediate predecessor file (the base for the
@@ -273,14 +280,15 @@ def save_delta(doc, path: str, *, base_crc: int, prev_crc: int,
             f"delta from_order {from_order} is ahead of the document "
             f"({next_order}) — stale chain state, re-save a full base")
     blob = columnar.encode_txns_stream(export_txns_since(doc, from_order))
-    meta = {
+    meta = dict(extra_meta or {})
+    meta.update({
         "version": FORMAT_VERSION,
         "kind": "delta",
         "base_crc": int(base_crc),
         "prev_crc": int(prev_crc),
         "from_order": int(from_order),
         "next_order": int(next_order),
-    }
+    })
     arrays = dict(txns_blob=np.frombuffer(blob, dtype=np.uint8))
     crc = _save_npz(path, meta, arrays)
     return {"crc": crc, "next_order": next_order,
@@ -325,7 +333,16 @@ def replay_chain(base_path: str, delta_paths: List[str]):
     the decoded txns in stream order — order assignment is sequential,
     so the restored document is the one the live replica held.
     """
+    return replay_chain_with_meta(base_path, delta_paths)[0]
+
+
+def replay_chain_with_meta(base_path: str, delta_paths: List[str]):
+    """``replay_chain`` that also returns the TIP file's meta header
+    (the last link's, or the base's for a link-less chain) — where the
+    serve tier's extra meta (doc id, local-edit replay watermark) rides
+    at its freshest."""
     doc, base_meta = _load_doc_with_meta(base_path)
+    tip_meta = base_meta
     base_crc = base_meta["crc"]
     prev_crc = base_crc
     cursor = int(base_meta.get("next_order", 0))
@@ -357,7 +374,8 @@ def replay_chain(base_path: str, delta_paths: List[str]):
                 f"{doc.get_next_order()}, link claims {meta['next_order']}")
         prev_crc = meta["crc"]
         cursor = meta["next_order"]
-    return doc
+        tip_meta = meta
+    return doc, tip_meta
 
 
 class CheckpointChain:
@@ -389,7 +407,63 @@ class CheckpointChain:
     def _link_path(self) -> str:
         return f"{self.stem}.d{len(self.links):04d}.npz"
 
-    def save(self, doc) -> dict:
+    @classmethod
+    def from_disk(cls, stem: str, *, compact_ops: int = 4096,
+                  compact_links: int = 16):
+        """Rebuild chain state from files on disk (crash recovery: the
+        in-memory ``base_info``/``links`` died with the process).
+
+        Returns ``(chain, refused, tip_meta)`` where ``refused`` lists
+        the link paths dropped for failing validation — a torn tail
+        link truncates the chain to its valid prefix (the journal
+        replays the rest), and the next ``save`` overwrites the
+        refused file — and ``tip_meta`` is the newest VALID file's meta
+        header (where serve-tier extra meta rides).  A corrupt or
+        absent BASE is a typed ``CheckpointError``: with no base, no
+        prefix of the chain is restorable.
+        """
+        chain = cls(stem, compact_ops=compact_ops,
+                    compact_links=compact_links)
+        base_meta, _ = _load_npz(chain.base_path, expect_kind="oracle")
+        tip_meta = base_meta
+        chain.base_info = {
+            "crc": base_meta["crc"],
+            "next_order": int(base_meta.get("next_order", 0)),
+            "bytes": os.path.getsize(chain.base_path),
+        }
+        refused: List[str] = []
+        prev_crc = base_meta["crc"]
+        cursor = chain.base_info["next_order"]
+        k = 0
+        while True:
+            path = f"{stem}.d{k:04d}.npz"
+            if not os.path.exists(path):
+                break
+            try:
+                meta, _txns = load_delta(path)
+                if (meta["base_crc"] != chain.base_info["crc"]
+                        or meta["prev_crc"] != prev_crc
+                        or meta["from_order"] != cursor):
+                    raise CheckpointError(
+                        f"delta {path!r}: chain linkage mismatch")
+            except CheckpointError:
+                # Valid-prefix recovery: this link (and anything after
+                # it) is unusable; the journal suffix covers the gap.
+                refused.append(path)
+                break
+            chain.links.append({
+                "path": path, "crc": meta["crc"],
+                "next_order": meta["next_order"],
+                "ops": meta["next_order"] - meta["from_order"],
+                "bytes": os.path.getsize(path),
+            })
+            prev_crc = meta["crc"]
+            cursor = meta["next_order"]
+            tip_meta = meta
+            k += 1
+        return chain, refused, tip_meta
+
+    def save(self, doc, extra_meta: Optional[dict] = None) -> dict:
         """Checkpoint ``doc``; returns ``{"kind", "bytes", "ops"}`` —
         what the residency layer's byte counters record.
 
@@ -414,14 +488,16 @@ class CheckpointChain:
                 if os.path.exists(link["path"]):
                     os.remove(link["path"])
             self.links = []
-            self.base_info = save_doc(doc, self.base_path)
+            self.base_info = save_doc(doc, self.base_path,
+                                      extra_meta=extra_meta)
             return {"kind": "full", "bytes": self.base_info["bytes"],
                     "ops": self.base_info["next_order"]}
         path = self._link_path()
         prev_crc = self.links[-1]["crc"] if self.links \
             else self.base_info["crc"]
         info = save_delta(doc, path, base_crc=self.base_info["crc"],
-                          prev_crc=prev_crc, from_order=tip)
+                          prev_crc=prev_crc, from_order=tip,
+                          extra_meta=extra_meta)
         info["path"] = path
         self.links.append(info)
         return {"kind": "delta", "bytes": info["bytes"], "ops": info["ops"]}
@@ -429,10 +505,15 @@ class CheckpointChain:
     def load(self):
         """Restore the chained document (typed refusal on any broken
         link)."""
+        return self.load_with_meta()[0]
+
+    def load_with_meta(self):
+        """``(doc, tip_meta)`` — the restored document plus the tip
+        file's meta header (freshest extra meta)."""
         if self.base_info is None:
             raise CheckpointError(f"chain {self.stem!r} has no base")
-        return replay_chain(self.base_path,
-                            [link["path"] for link in self.links])
+        return replay_chain_with_meta(
+            self.base_path, [link["path"] for link in self.links])
 
 
 def save_flat_doc(flat, path: str) -> None:
