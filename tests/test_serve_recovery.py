@@ -125,6 +125,59 @@ def test_journal_bitflip_sweep(tmp_path):
         fh.write(pristine)
 
 
+def test_journal_reopen_repairs_torn_tail(tmp_path):
+    """The double-crash hole: reopening a journal whose tail is torn
+    must truncate the tear to the valid prefix (quarantining the torn
+    bytes), or the NEXT scan would refuse the stale torn segment and
+    drop every post-recovery segment of that shard behind it."""
+    d, records = _small_journal(tmp_path)
+    last = records[-1]
+    size = os.path.getsize(last.segment)
+    with open(last.segment, "r+b") as fh:
+        fh.truncate(last.offset + max(1, (size - last.offset) // 2))
+    jr = J.Journal(d, num_shards=1)  # post-crash reopen: repairs
+    assert [e.offset for e in jr.repair_errors] == [last.offset]
+    jr.admit(0, "docC")              # the post-recovery durable record
+    jr.close()
+    got, errors = J.scan(d)          # what crash #2's recovery sees
+    assert not errors, "post-repair scan must be clean"
+    assert got[-1].body.decode() == "docC", \
+        "post-recovery record lost behind the stale torn segment"
+    assert [r.seq for r in got[:-1]] == [r.seq for r in records[:-1]]
+    assert got[-1].seq == got[-2].seq + 1
+    # Forensics survive: the torn bytes moved to a .refused sidecar.
+    assert os.path.exists(last.segment + ".refused")
+
+
+def test_journal_repair_quarantines_dead_segments(tmp_path):
+    """A refused EARLY segment ends its shard's recoverable stream;
+    repair must quarantine the later (never-replayed) segments too, so
+    a post-repair scan cannot resurface records recovery never saw."""
+    d = str(tmp_path / "jr")
+    jr = J.Journal(d, num_shards=1, rotate_bytes=1)  # rotate every tick
+    for i, doc in enumerate(("docA", "docB", "docC")):
+        jr.admit(0, doc)
+        jr.tick(i + 1)
+    jr.close()
+    records, errors = J.scan(d)
+    assert not errors
+    segs = sorted({r.segment for r in records})
+    assert len(segs) == 3, "shape bug: expected one segment per tick"
+    pristine = open(segs[0], "rb").read()
+    with open(segs[0], "wb") as fh:  # corrupt the FIRST segment
+        fh.write(b"XXXX" + pristine[4:])
+    jr2 = J.Journal(d, num_shards=1, rotate_bytes=1)
+    assert len(jr2.repair_errors) == 3  # 1 refused + 2 dropped behind it
+    jr2.admit(0, "docD")
+    jr2.close()
+    got, errors = J.scan(d)
+    assert not errors
+    assert [r.body.decode() for r in got if r.kind == J.REC_ADMIT] == \
+        ["docD"], "dead segments must not resurface after repair"
+    for seg in segs:
+        assert os.path.exists(seg + ".refused")
+
+
 def test_journal_header_corruption_refused(tmp_path):
     d, records = _small_journal(tmp_path)
     seg = records[0].segment
@@ -196,6 +249,45 @@ def test_recovery_journal_bytes_counted(tmp_path):
     assert c.get("journal_bytes") > 0
     assert c.get("journal_records") > 0
     assert c.get("journal_ops") > 0
+
+
+def test_recovery_crash_recover_crash_recover(tmp_path):
+    """Double-crash end-to-end: ops accepted AFTER a recovery from a
+    torn journal must survive the NEXT crash.  Before reopen-time
+    repair, the stale torn segment made the second scan drop every
+    post-recovery segment of its shard — fsynced records vanished."""
+    from text_crdt_rust_tpu.serve.chaos import tear_last_record
+
+    cfg, gen = _journaled_run(tmp_path, docs=4, agents_per_doc=2,
+                              ticks=5, events_per_tick=8, seed=11,
+                              fault_rate=0.10)
+    # Crash #1: a power cut mid-append tears shard 0's final record.
+    assert tear_last_record(cfg.journal_dir, shard=0) is not None
+    cfg2 = ServeConfig(num_shards=2, lanes_per_shard=2,
+                       journal_dir=cfg.journal_dir,
+                       spool_dir=cfg.spool_dir)
+    server2 = DocServer(cfg2)
+    stats2 = server2.recover()
+    assert stats2["refusals"] >= 1, "the torn tail must refuse loudly"
+    # Post-recovery traffic: journaled, flushed, fsynced at each tick.
+    doc_id = sorted(server2.router.docs)[0]
+    for i in range(3):
+        server2.submit_local(doc_id, "survivor", 0, 0, f"post{i} ")
+        server2.tick()
+    server2.flush_pipeline()
+    want = logical_stream_digest(server2)
+    # Crash #2: abandon server2 — no close, no drain, no final fsync.
+    server3 = DocServer(ServeConfig(num_shards=2, lanes_per_shard=2,
+                                    journal_dir=cfg.journal_dir,
+                                    spool_dir=cfg.spool_dir))
+    stats3 = server3.recover()
+    assert stats3["refusals"] == 0, \
+        "crash #1's reopen repaired the journal; #2 must scan clean"
+    assert stats3["locals_replayed"] >= stats2["locals_replayed"] + 3, \
+        "post-recovery local edits lost by the second recovery"
+    server3.flush_pipeline()
+    assert logical_stream_digest(server3) == want
+    server3.close_obs()
 
 
 # -- the batcher crash-path bugfix -------------------------------------------

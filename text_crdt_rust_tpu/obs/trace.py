@@ -87,6 +87,10 @@ EVENT_SCHEMA: Dict[str, Tuple[str, ...]] = {
     # Durability + recovery (ISSUE 16, serve/journal.py + chaos.py).
     "journal.segment": ("shard", "seg"),
     "journal.refuse": ("segment", "offset", "reason"),
+    # Reopen-time repair: a refused suffix truncated/quarantined to a
+    # ``.refused`` sidecar so post-recovery segments survive the next
+    # scan (same fields as the refusal it repairs).
+    "journal.repair": ("segment", "offset", "reason"),
     "recovery.replay": ("records", "ops", "ticks"),
     "chaos.crash": ("phase",),
     "flow.emit": ("doc", "agent", "n"),

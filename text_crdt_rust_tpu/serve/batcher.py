@@ -735,9 +735,11 @@ class ContinuousBatcher:
             if event.kind == EV_LOCAL and event.ordinal is not None:
                 # The local-edit durability watermark advances on
                 # PROCESSING, not success: a validity-dropped local
-                # consumed its ordinal and must not replay after a
-                # crash (ISSUE 16 — journal replay skips ordinals
-                # below this).
+                # consumed its ordinal too (ISSUE 16).  Checkpointed as
+                # an audit stamp reserved for future incremental
+                # recovery — today's replay is from genesis and checks
+                # ordinals against ``local_seen`` (``local_gaps``); it
+                # does not skip on this watermark.
                 doc.local_applied = max(doc.local_applied,
                                         event.ordinal + 1)
             if not ok:

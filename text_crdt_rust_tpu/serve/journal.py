@@ -56,6 +56,14 @@ suffix as a typed ``JournalError`` naming segment and byte offset —
 corruption is never silent.  Records from all shards merge into one
 total order on the global sequence number, which is what
 ``DocServer.recover()`` replays.
+
+``repair`` makes the disk agree with the scan: every refused suffix is
+truncated/quarantined (to ``<segment>.refused`` sidecars — forensic
+bytes are moved, never destroyed) so a reopened journal's NEW segments
+can never be dropped behind a stale torn segment on the next scan.
+``Journal.__init__`` runs it on every reopen, which is what makes a
+crash → recover → crash → recover sequence lossless for the records
+journaled between the crashes.
 """
 from __future__ import annotations
 
@@ -223,9 +231,21 @@ class Journal:
         self._suspended = 0
         self._closed = False
         os.makedirs(journal_dir, exist_ok=True)
-        # Continue the global sequence past whatever is already on disk
-        # so a post-recovery journal never reuses sequence numbers.
-        existing, _errors = scan(journal_dir)
+        # Repair before anything else: truncate/quarantine any refused
+        # suffix a crash left behind, so (a) the global sequence
+        # continues past the last RECOVERABLE record (never reusing
+        # sequence numbers) and (b) segments this reopen appends are
+        # never dropped behind a stale torn segment on the next scan —
+        # the double-crash data-loss hole.  The refusals stay loud:
+        # counted and traced here, and ``DocServer.recover()`` folds
+        # ``self.repair_errors`` into its replay stats and the flight
+        # recorder.
+        existing, self.repair_errors = repair(journal_dir)
+        for err in self.repair_errors:
+            self._count("journal_refusals")
+            if self.tracer is not None:
+                self.tracer.event("journal.repair", segment=err.segment,
+                                  offset=err.offset, reason=err.reason)
         if existing:
             self._seq = existing[-1].seq + 1
         self._shards = [_ShardLog(s) for s in range(num_shards)]
@@ -286,6 +306,12 @@ class Journal:
         self._count("journal_records")
         self._count("journal_bytes", len(rec))
         if kind == REC_TICK and log.size >= self.rotate_bytes:
+            # fsync before the handle goes away: ``tick()``'s cadenced
+            # fsync loop only sees OPEN handles, so a rotated-out
+            # segment's tail would otherwise never be fsynced — a
+            # power-loss hole at exactly the rotating tick.
+            os.fsync(log.fh.fileno())
+            self._count("journal_fsyncs")
             log.fh.close()
             log.fh = None
             log.index += 1
@@ -426,9 +452,13 @@ def scan(journal_dir: str
 
     Per shard, segments are read in index order; the first refused
     record ends that shard's stream — later segments of the same shard
-    are dropped too (their records are causally after the refusal) and
-    reported.  The returned error list is the loud part: callers count
-    and trace every entry."""
+    are dropped too and reported (within one append epoch their bytes
+    were written after the refused ones, so keeping them would admit
+    records whose prefix is gone).  ``repair`` — run at every
+    ``Journal`` reopen — truncates/quarantines refused suffixes
+    precisely so segments from a LATER epoch (post-recovery appends)
+    are never dropped behind them.  The returned error list is the
+    loud part: callers count and trace every entry."""
     records: List[JournalRecord] = []
     errors: List[JournalError] = []
     if not os.path.isdir(journal_dir):
@@ -459,4 +489,47 @@ def scan(journal_dir: str
                 errors.append(err)
                 broken = True
     records.sort(key=lambda r: r.seq)
+    return records, errors
+
+
+def repair(journal_dir: str
+           ) -> Tuple[List[JournalRecord], List[JournalError]]:
+    """Scan, then make the disk AGREE with the scan: after repair, a
+    fresh ``scan`` returns exactly the records this call returned and
+    no errors.
+
+    Without this, a reopened journal appends post-recovery records to
+    NEW segments of a shard whose torn segment is still on disk — and
+    the next scan, refusing the stale torn record first, would drop
+    those fully durable later segments ("earlier segment refused").
+    Recovery already discarded the refused suffix, so records written
+    after it are causally independent of it and must survive a second
+    crash.  ``Journal.__init__`` calls this on every reopen.
+
+    Refused bytes are moved, never destroyed: a refused record suffix
+    is cut from its segment into a ``<segment>.refused`` sidecar; a
+    segment refused whole (bad header, or dropped behind an earlier
+    refused segment of its shard — recovery never replayed it) is
+    renamed to ``<segment>.refused``.  The ``.refused`` namespace is
+    invisible to ``scan`` and to the segment-index allocator.
+
+    Returns the same ``(records, errors)`` as the pre-repair scan."""
+    records, errors = scan(journal_dir)
+    for err in errors:
+        if not os.path.exists(err.segment):
+            continue
+        if err.offset == 0:
+            # Nothing in the segment was recovered (bad magic/header)
+            # or nothing in it was replayed (dropped behind a refused
+            # earlier segment): quarantine the whole file.
+            os.replace(err.segment, err.segment + ".refused")
+            continue
+        with open(err.segment, "r+b") as fh:
+            fh.seek(err.offset)
+            tail = fh.read()
+            with open(err.segment + ".refused", "wb") as side:
+                side.write(tail)
+            fh.truncate(err.offset)
+            fh.flush()
+            os.fsync(fh.fileno())
     return records, errors

@@ -196,8 +196,9 @@ class LaneResidency:
         # Extra meta rides every save (ISSUE 16): the doc id maps files
         # back to docs when recovery rediscovers checkpoints from disk
         # (``_ckpt_ids`` died with the process), and ``local_applied``
-        # is the local-edit replay watermark — written atomically with
-        # the oracle state it describes.
+        # is an audit stamp written atomically with the oracle state it
+        # describes — reserved for future incremental recovery; today's
+        # replay re-executes from genesis and never reads it back.
         extra = {"doc_id": doc.doc_id,
                  "local_applied": doc.local_applied}
         if self.ckpt_format == "delta":
@@ -316,7 +317,9 @@ class LaneResidency:
         the journal from genesis, so replayed evictions lay down fresh
         checkpoint files — registering a crash-time chain here would
         hand a replayed (earlier-order) evict a tip from its own
-        future.  Pre-crash files survive untouched for forensics; the
+        future.  (``local_applied`` is surfaced for that future
+        incremental path; genesis replay does not read it.)  Pre-crash
+        files survive untouched for forensics; the
         advanced ``_next_ckpt_id`` keeps fresh files clear of them,
         refused numbers included."""
         found: Dict[str, dict] = {}

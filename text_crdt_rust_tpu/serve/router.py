@@ -66,10 +66,13 @@ class Event:
 
     ``ordinal`` is a LOCAL edit's per-doc durability ordinal (ISSUE
     16): assigned densely at admission, advanced into
-    ``DocState.local_applied`` when the batcher processes the event —
-    the watermark that makes journal replay of local edits
-    exactly-once (a validity-dropped local leaves no oracle state, so
-    no oracle-derived watermark could cover it)."""
+    ``DocState.local_applied`` when the batcher processes the event.
+    Replay is exactly-once because recovery re-executes the journal
+    from genesis; the recorded ordinal is its audit (``local_gaps``
+    checks it against the rebuilt ``local_seen``), and
+    ``local_applied`` is the checkpointed stamp a future INCREMENTAL
+    recovery would skip below (a validity-dropped local leaves no
+    oracle state, so no oracle-derived watermark could cover it)."""
 
     __slots__ = ("kind", "payload", "items", "t_submit", "tick_submit",
                  "lk", "span", "ordinal")
@@ -128,7 +131,9 @@ class DocState:
         # the next ordinal to assign at submit; ``local_applied`` counts
         # ordinals the batcher has PROCESSED (applied or
         # validity-dropped).  ``local_applied`` rides checkpoint extra
-        # meta so recovery replays each journaled local exactly once.
+        # meta as an audit stamp reserved for future incremental
+        # (checkpoint-anchored) recovery; today's replay re-executes
+        # from genesis and audits ordinals against ``local_seen``.
         self.local_seen = 0
         self.local_applied = 0
 

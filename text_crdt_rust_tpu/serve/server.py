@@ -227,8 +227,10 @@ class DocServer:
         constructed with the dead server's ``spool_dir``/``journal_dir``.
 
         The server is a deterministic state machine, so recovery is
-        re-execution: scan the journal (valid prefix per shard, typed
-        refusals counted + traced), audit the checkpoint spool
+        re-execution: scan the journal (valid prefix per shard; typed
+        refusals counted + traced, their suffixes already repaired
+        away at Journal reopen so post-recovery appends survive a
+        second crash), audit the checkpoint spool
         (corruption reported, file allocator advanced past the crashed
         process's files), then replay the merged record stream through
         the NORMAL admission -> buffer -> batcher path with journaling
@@ -250,9 +252,18 @@ class DocServer:
             "recover() needs cfg.journal_dir (durability was off)"
         assert not self.router.docs, \
             "recover() must run on a fresh server, before any traffic"
-        records, errors = J.scan(self.cfg.journal_dir)
-        for err in errors:
+        records, fresh_errors = J.scan(self.cfg.journal_dir)
+        # Refusals were detected — and the refused suffixes repaired
+        # (truncated/quarantined, so post-recovery segments can never
+        # be dropped behind them on the NEXT crash's scan) — when this
+        # server's Journal reopened the directory.  Those were counted
+        # at reopen; report them through the recovery channel too.
+        # ``fresh_errors`` (disk mutated between reopen and recover)
+        # should be empty, but if not, count them like any refusal.
+        for err in fresh_errors:
             self.counters.incr("journal_refusals")
+        errors = list(self.journal.repair_errors) + fresh_errors
+        for err in errors:
             self.tracer.event("journal.refuse", segment=err.segment,
                               offset=err.offset, reason=err.reason)
             if self.recorder is not None:
@@ -280,7 +291,6 @@ class DocServer:
                     try:
                         kind, groups, _, _ = codec.decode_frame_ex(
                             bytes(rec.body))
-                        assert kind == codec.KIND_TXNS_MUX
                     except codec.CodecError as e:
                         # CRC-chained records should never decode dirty;
                         # if one does, refuse it loudly and keep going.
@@ -289,6 +299,17 @@ class DocServer:
                             "journal.refuse", segment=rec.segment,
                             offset=rec.offset,
                             reason=f"undecodable TXNS body: {e}")
+                        continue
+                    if kind != codec.KIND_TXNS_MUX:
+                        # Same taxonomy as an undecodable body: a TXNS
+                        # record carrying a non-mux frame is a typed
+                        # per-record refusal, never a replay abort.
+                        self.counters.incr("journal_refusals")
+                        self.tracer.event(
+                            "journal.refuse", segment=rec.segment,
+                            offset=rec.offset,
+                            reason=f"TXNS body kind {kind} is not "
+                                   f"TXNS_MUX")
                         continue
                     for doc_id, txns in groups:
                         for txn in txns:
