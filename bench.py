@@ -1304,7 +1304,8 @@ def cfg_serve(args):
     reports = {}
     for wire, ckpt in (("row", "full"), ("columnar", "delta")):
         scfg = ServeConfig(engine=engine, num_shards=2, lanes_per_shard=16,
-                           wire_format=wire, ckpt_format=ckpt)
+                           wire_format=wire, ckpt_format=ckpt,
+                           train_ticks=2)
         gen = ServeLoadGen(docs=docs, agents_per_doc=3, ticks=ticks,
                            events_per_tick=events, zipf_alpha=1.1,
                            fault_rate=0.10, local_prob=0.25, seed=7,
@@ -1377,6 +1378,13 @@ def cfg_serve(args):
             "bytes_cut_x", 0.0),
         prefill_scatter_compiles=(report.get("prefill") or {}).get(
             "scatter_compiles", 0),
+        # ISSUE 20: tick-train ride-alongs (additive fields): the train
+        # length the run shipped under and the realized device-dispatch
+        # cut vs the serial one-dispatch-per-tick loop (partial flushes
+        # at residency boundaries keep it below the depth ceiling).
+        train_ticks=(report.get("train") or {}).get("ticks", 1),
+        dispatch_cut_x=(report.get("train") or {}).get(
+            "dispatch_cut_x", 1.0),
         nagle_txns=col_wire.get("nagle_txns"),
         nagle_rounds=col_wire.get("nagle_rounds"),
         wire_format=col_wire["format"],

@@ -92,6 +92,11 @@ SMALL_LOADGEN = dict(docs=6, agents_per_doc=2, ticks=6,
                      events_per_tick=12, zipf_alpha=1.1, fault_rate=0.10,
                      local_prob=0.25, seed=SEED)
 SERVE_SHAPE = dict(num_shards=1, lanes_per_shard=4)
+SERVE_TRAIN_TICKS = 2  # the serve cell rides a depth-2 tick train
+#                        (ISSUE 20) so the pinned dispatch metrics are
+#                        nontrivial — every OTHER serve metric must
+#                        still match the serial record bit for bit
+#                        (train length is a wall-clock-only knob)
 FUSED_TRACE = "automerge-paper"
 FUSED_PATCHES = 4000
 from text_crdt_rust_tpu.config import ServeConfig as _ServeConfig  # noqa: E402
@@ -203,7 +208,8 @@ def cell_serve_pair():
     cap_runs, NB, NBT = lane_block_geometry(base.lane_capacity, K)
     OCAP = base.order_capacity
 
-    cfg = ServeConfig(engine="flat", **SERVE_SHAPE)
+    cfg = ServeConfig(engine="flat", train_ticks=SERVE_TRAIN_TICKS,
+                      **SERVE_SHAPE)
     gen = ServeLoadGen(cfg=cfg, **SMALL_LOADGEN)
 
     c = BLS.Counter()
@@ -250,6 +256,17 @@ def cell_serve_pair():
         # compile: steady state must cycle a fixed kernel set.
         "device_compiles": metric(srv.get("device_compiles", 0),
                                   "compile"),
+        # train (ISSUE 20): the tick-train dispatch economy at the
+        # pinned depth-2 train.  Dispatch counts are logical (same-seed
+        # deterministic; partial flushes land at seeded residency
+        # boundaries), so they pin exactly in the "steps" family —
+        # another named-diff guard: a scheduler change that silently
+        # flushes trains shows up here as a dispatch regression.
+        "device_dispatches": metric(tick.get("device_dispatches", 0),
+                                    "steps"),
+        "device_dispatches_per_tick": metric(
+            tick.get("device_dispatches_per_tick", 0.0), "steps"),
+        "train_len": metric(tick.get("train_len", 0.0), "steps"),
         # prefill (ISSUE 14): the device-resident log path's byte
         # economy — scatter-delta bytes vs the full-log round trip the
         # host path would move, the un-padded scatter volume, and the
@@ -309,6 +326,7 @@ def cell_serve_pair():
     serve_cell = {
         "kind": "cpu",
         "workload": {**SMALL_LOADGEN, **SERVE_SHAPE, "engine": "flat",
+                     "train_ticks": SERVE_TRAIN_TICKS,
                      "wire": cfg.wire_format, "ckpt": cfg.ckpt_format,
                      "hlo_buckets": list(HLO_BUCKETS),
                      "hlo_lanes": SERVE_SHAPE["lanes_per_shard"]},

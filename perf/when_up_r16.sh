@@ -1,0 +1,154 @@
+#!/bin/bash
+# Round-16 tick-train watcher (ISSUE 20 / dispatch amortization):
+# supersedes when_up_r15.sh and keeps its gate chain — matmul tunnel
+# probe -> compile pin -> fused kevin device smoke -> device-prefill
+# pipelined serve smoke -> host-prefill arm -> sanitized pipelined
+# smoke -> journaled smoke -> crash/recover smoke -> fused serve-lanes
+# smoke -> kevin full 5M -> remaining rows -> cost-ledger device
+# re-record.  New in r16: TICK-TRAIN device smokes (depth 2 and 4) run
+# before any re-record is trusted — T ticks' op tensors replayed as ONE
+# lax.scan program on real async dispatch.  On CPU the train matrix is
+# tier-1-proven (PERF.md §22: sha-identical streams, 3.77x dispatch cut
+# at depth 4); on silicon it is the first time the T-for-one launch
+# amortization meets real dispatch latency, which is the entire point
+# of the feature — the CPU wall gate is parity-within-noise, the chip
+# is where the cut should become wall.  Safe to re-run; appends to
+# perf/when_up_r16.log.
+set -u
+cd /root/repo
+while true; do
+  if timeout 240 python -c "
+import jax, numpy as np, jax.numpy as jnp
+x = jnp.ones((128,128), jnp.bfloat16)
+assert float(np.asarray(x @ x)[0,0]) == 128.0
+" >/dev/null 2>&1; then
+    echo "$(date -u +%H:%M:%S) tunnel is back (r16 watcher)" >> perf/when_up_r16.log
+    break
+  fi
+  echo "$(date -u +%H:%M:%S) still down (r16)" >> perf/when_up_r16.log
+  sleep 120
+done
+timeout 2400 python perf/compile_pin.py >> perf/compile_pin_r16.log 2>&1 \
+  || echo "PIN FAILED/TIMED OUT rc=$? - investigate before trusting bench" \
+       >> perf/compile_pin_r16.log
+# Fused-kernel device smoke first: a tiny fused kevin (2048 prepends,
+# W=8) proves the W-row splice compiles on real Mosaic before
+# committing to the 40-min full run.
+timeout 1800 python bench.py --config kevin --smoke --no-probe \
+  >> perf/when_up_r16.log 2>&1 \
+  || { echo "fused kevin device smoke FAILED rc=$? - NOT re-recording" \
+         >> perf/when_up_r16.log; exit 1; }
+# DEVICE-PREFILL pipelined serve smoke: the delta scatter +
+# double-buffered tick on real async dispatch.  Convergence + lane
+# bit-identity must hold before anything else is trusted.
+timeout 1800 python -m text_crdt_rust_tpu.serve.loadgen --device \
+  --docs 24 --ticks 10 --pipeline-ticks 2 \
+  >> perf/when_up_r16.log 2>&1 \
+  || { echo "device-prefill pipelined serve smoke FAILED rc=$? - NOT " \
+            "re-recording" >> perf/when_up_r16.log; exit 1; }
+# TICK-TRAIN device smokes (new in r16): depth 2 then depth 4 — the
+# outer-scan train program, the concatenated prefill scatter, the
+# device-accumulated overflow flag and its non-blocking drain
+# (jax.Array.is_ready), all under real async dispatch for the first
+# time.  Convergence + lane bit-identity gate; a failure here is a
+# train-scheduler bug the CPU arms could not exhibit (e.g. a flag
+# drain racing genuinely-async dispatch).
+timeout 1800 python -m text_crdt_rust_tpu.serve.loadgen --device \
+  --docs 24 --ticks 10 --pipeline-ticks 2 --train-ticks 2 \
+  >> perf/when_up_r16.log 2>&1 \
+  || { echo "depth-2 tick-train device smoke FAILED rc=$? - NOT " \
+            "re-recording" >> perf/when_up_r16.log; exit 1; }
+timeout 1800 python -m text_crdt_rust_tpu.serve.loadgen --device \
+  --docs 24 --ticks 10 --pipeline-ticks 2 --train-ticks 4 \
+  >> perf/when_up_r16.log 2>&1 \
+  || { echo "depth-4 tick-train device smoke FAILED rc=$? - NOT " \
+            "re-recording" >> perf/when_up_r16.log; exit 1; }
+# The HOST-PREFILL arm of the same seed: the two prefill paths must
+# stay byte-identical on silicon too (the ISSUE-14 contract the CPU
+# suite pins; a divergence here is a chip-side scatter bug).
+timeout 1800 python -m text_crdt_rust_tpu.serve.loadgen --device \
+  --docs 24 --ticks 10 --pipeline-ticks 2 --host-prefill \
+  >> perf/when_up_r16.log 2>&1 \
+  || { echo "host-prefill serve smoke FAILED rc=$? - NOT re-recording" \
+         >> perf/when_up_r16.log; exit 1; }
+# SANITIZED pipelined serve device smoke: the aliasing sanitizer under
+# real async dispatch.  A failure here is a REAL
+# host-write-races-device-step bug the CPU arms could never exhibit.
+timeout 1800 python -m text_crdt_rust_tpu.serve.loadgen --device \
+  --docs 24 --ticks 10 --pipeline-ticks 2 --sanitize-pipeline \
+  >> perf/when_up_r16.log 2>&1 \
+  || { echo "SANITIZED pipelined device smoke FAILED rc=$? - aliasing " \
+            "race on silicon? NOT re-recording" \
+         >> perf/when_up_r16.log; exit 1; }
+# JOURNALED pipelined device smoke: the write-ahead journal appending
+# at the admission edge while real async device steps are in flight.
+# The journal is host-side and logically invisible by construction —
+# this proves it stays that way when dispatch is genuinely
+# asynchronous (convergence gate; the journal fsyncs every tick).
+rm -rf /tmp/tcr_r16_journal && mkdir -p /tmp/tcr_r16_journal
+timeout 1800 python -m text_crdt_rust_tpu.serve.loadgen --device \
+  --docs 24 --ticks 10 --pipeline-ticks 2 \
+  --journal-dir /tmp/tcr_r16_journal --journal-fsync-ticks 1 \
+  >> perf/when_up_r16.log 2>&1 \
+  || { echo "JOURNALED pipelined device smoke FAILED rc=$? - NOT " \
+            "re-recording" >> perf/when_up_r16.log; exit 1; }
+# CRASH/RECOVER device smoke: kill post-dispatch with a depth-2
+# pipeline in flight, recover a FRESH server from the journal (replay
+# through the normal admission path, re-derive the crashed tick),
+# resume the workload, and byte-compare logical streams against the
+# uncrashed same-seed twin — the PERF.md §21 contract on real
+# hardware.  Exit 1 = digests differ or a crash-boundary flow audit
+# finding; NOT re-recording on that.
+timeout 1800 python -m text_crdt_rust_tpu.serve.loadgen --device \
+  --docs 16 --ticks 10 --crash-at post-dispatch:5 \
+  >> perf/when_up_r16.log 2>&1 \
+  || { echo "device CRASH/RECOVER smoke FAILED rc=$? - recovery " \
+            "divergence on silicon? NOT re-recording" \
+         >> perf/when_up_r16.log; exit 1; }
+# Fused serve-lanes loadgen smoke — the blocked mixed kernel's fused
+# splice + the serve stack's fused ticks on device; the lanes backend
+# PIPELINES at depth 2 (host-mirrored row true-up), so this smoke
+# also exercises its staged sync on real hardware.
+timeout 1800 python -m text_crdt_rust_tpu.serve.loadgen --device \
+  --docs 24 --ticks 10 --engine rle-lanes-mixed \
+  >> perf/when_up_r16.log 2>&1 \
+  || { echo "fused serve-lanes device smoke FAILED rc=$? - NOT re-recording" \
+         >> perf/when_up_r16.log; exit 1; }
+# Headline: kevin at full 5M, fused W=64 (rle-hbm-fused row).
+timeout 7200 python bench.py --config kevin --merge-rows --no-probe \
+  >> perf/bench_kevin_r16.log 2>&1 \
+  || echo "kevin re-record FAILED rc=$?" >> perf/when_up_r16.log
+# Remaining rows, most verdict-critical first; every merged row is
+# ledger_version-stamped by the exporter.  The serve row now ships
+# train_ticks=2 (its train/dispatch ride-alongs land on silicon here).
+for cfg in northstar 4 5r 5 serve serve-lanes sp; do
+  timeout 7200 python bench.py --config "$cfg" --merge-rows --no-probe \
+    >> "perf/bench_cfg${cfg}_r16.log" 2>&1 \
+    || echo "config $cfg re-record FAILED rc=$?" >> perf/when_up_r16.log
+done
+# The train probe at full scale on silicon: the committed CPU record
+# (perf/train_r17.json) pins sha-identity + the dispatch cut; the
+# device run is where the cut becomes wall.  Writes a SEPARATE file —
+# the CPU record stays the tier-1 reference.
+timeout 3600 python perf/train_probe.py --device \
+  --out perf/train_r17_device.json \
+  >> perf/when_up_r16.log 2>&1 \
+  || echo "device train probe FAILED rc=$?" >> perf/when_up_r16.log
+# The cost-ledger silicon cells: device-step wall histograms +
+# real-HLO costs + the flow-device per-op provenance cell, appended to
+# the committed ledger (cpu cells untouched).
+timeout 3600 python perf/cost_ledger_probe.py --device \
+  >> perf/when_up_r16.log 2>&1 \
+  || echo "ledger device re-record FAILED rc=$?" >> perf/when_up_r16.log
+# And prove the cpu contracts still hold from this very checkout:
+# cost ledger (now including the train dispatch-economy metrics) + the
+# tcrlint gate (a drifted tree must not re-record).
+timeout 1800 env JAX_PLATFORMS=cpu python bench.py --check-ledger \
+  >> perf/when_up_r16.log 2>&1 \
+  || echo "LEDGER CHECK FAILED rc=$? - cpu cost contract drifted" \
+       >> perf/when_up_r16.log
+timeout 600 env JAX_PLATFORMS=cpu python -m text_crdt_rust_tpu.analysis.lint \
+  >> perf/when_up_r16.log 2>&1 \
+  || echo "TCRLINT FAILED rc=$? - determinism/schema finding on this checkout" \
+       >> perf/when_up_r16.log
+echo "$(date -u +%H:%M:%S) r16 re-record done" >> perf/when_up_r16.log

@@ -20,9 +20,18 @@ import pytest
 
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+    _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# Tests are compile-dominated on the 1-core CI box (hundreds of distinct
+# jitted programs, each compiled serially); backend optimization buys
+# nothing for correctness — the kernels are exact integer ops and every
+# suite pins bit-identity against the host oracle — so run the XLA
+# backend at optimization level 0 here.  Measured ~27% off the tier-1
+# wall (the 870s gate timeout had < 2% headroom).  Perf probes and
+# bench.py do NOT inherit this: it is test-harness-only by construction
+# (conftest), so recorded walls stay honest.
+if "xla_backend_optimization_level" not in _flags:
+    _flags = (_flags + " --xla_backend_optimization_level=0").strip()
+os.environ["XLA_FLAGS"] = _flags
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -283,16 +283,30 @@ MIRROR_CUT = """\
             stacked.order_advance, dtype=np.int64).sum(axis=0)
 """
 
+# The train-boundary mirror true-up inside _dispatch_train (ISSUE 20).
+# Stripping it alongside MIRROR_CUT removes EVERY path from apply's
+# device write to a mirror (direct and via the train_sync helper), so
+# the M001 injection stays loud — and the same cut is the M003 seeded
+# defect (the registered train_sync site no longer trues up).
+TRAIN_SYNC_CUT = """\
+        self._n_host = self._n_host + self._pending_n
+        self._next_order_host = self._next_order_host + self._pending_o
+"""
+
 
 def test_mirror_skip_injection_named_by_lint(tmp_path):
     """ISSUE 15 satellite: the REAL FlatLaneBackend.apply with its
     host-mirror updates deleted — the lint names the device-write line
     and the check id (the static half; the runtime half lives in
-    test_device_prefill.py)."""
+    test_device_prefill.py).  Both mirror-advance sites go: the serial
+    per-tick block AND the train-boundary true-up (which would
+    otherwise excuse apply via the one-level helper rule)."""
     rel = "text_crdt_rust_tpu/serve/batcher.py"
     p = tmp_path / rel
     p.parent.mkdir(parents=True, exist_ok=True)
-    p.write_text(_mutated_batcher(MIRROR_CUT))
+    src = _mutated_batcher(MIRROR_CUT)
+    assert TRAIN_SYNC_CUT in src, "train true-up anchor drifted"
+    p.write_text(src.replace(TRAIN_SYNC_CUT, ""))
     findings, _ = run_lint(str(tmp_path), [rel],
                            allowlist_path=str(tmp_path / "a.json"),
                            pins_path=str(tmp_path / "p.json"),
@@ -302,6 +316,53 @@ def test_mirror_skip_injection_named_by_lint(tmp_path):
     assert apply_hits, [f.format() for f in hits]
     assert apply_hits[0].scope == "FlatLaneBackend.apply"
     assert "_n_host" in apply_hits[0].message
+
+
+def test_train_sync_split_injection_named_by_lint(tmp_path):
+    """ISSUE 20 satellite (loud half): the REAL batcher with the
+    train-boundary mirror true-up deleted from _dispatch_train — the
+    registered train_sync site no longer writes a mirror in its own
+    body, and TCR-M003 names the method and the atomicity contract."""
+    rel = "text_crdt_rust_tpu/serve/batcher.py"
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(_mutated_batcher(TRAIN_SYNC_CUT))
+    findings, _ = run_lint(str(tmp_path), [rel],
+                           allowlist_path=str(tmp_path / "a.json"),
+                           pins_path=str(tmp_path / "p.json"),
+                           shape_pins_path=str(tmp_path / "sp.json"))
+    hits = the(findings, "TCR-M003")
+    assert hits, "train_sync cut not flagged"
+    assert hits[0].scope == "FlatLaneBackend._dispatch_train"
+    assert "atomic" in hits[0].message
+
+
+def test_train_sync_delegation_flagged_even_when_m001_passes(tmp_path):
+    """TCR-M003 is strictly stronger than M001 at the train boundary: a
+    train_sync site that delegates its mirror true-up to a same-class
+    helper passes M001's one-level rule but still fails M003 (the
+    true-up must be in the SAME method as the device write)."""
+    findings, _ = lint_tree(tmp_path, {
+        "text_crdt_rust_tpu/serve/mod.py": """\
+            class FlatLaneBackend:
+                def _true_up(self):
+                    self._n_host = self._n_host + self._pending_n
+
+                def _dispatch_train(self):
+                    self.docs = self.docs.at[0].set(0)
+                    self._true_up()
+            """})
+    none_of(findings, "TCR-M001")
+    hits = the(findings, "TCR-M003")
+    assert hits and hits[0].scope == "FlatLaneBackend._dispatch_train"
+
+
+def test_clean_tree_has_no_train_sync_findings():
+    """ISSUE 20 satellite (quiet half): the committed batcher's
+    _dispatch_train satisfies the atomic train_sync contract."""
+    findings, _ = run_lint(
+        REPO, ["text_crdt_rust_tpu/serve/batcher.py"])
+    none_of(findings, "TCR-M003")
 
 
 def test_clean_backends_pass_with_committed_allowlist():

@@ -10,6 +10,10 @@ import pytest
 import perf.columnar_wire_probe as probe
 
 
+# Slow tier since PR 17 (wall budget: ~25 s of the 870 s gate): wire
+# codec correctness keeps tier-1 coverage in test_net_codec /
+# test_net_faults; the committed-claims check below was always slow.
+@pytest.mark.slow
 def test_probe_smoke_matrix_holds():
     """The probe's small-scale path: every cell converges, the op
     counts match across protocol generations, and the columnar wire

@@ -29,6 +29,11 @@ def _load_probe():
     return mod
 
 
+# Slow tier since PR 17 (wall budget: ~23 s of the 870 s gate): the
+# fused-splice surfaces keep tier-1 bit-exactness coverage in
+# test_rle_fused / test_lanes_blocked; the full claims check below was
+# always slow-tier.
+@pytest.mark.slow
 def test_probe_smoke_path_green():
     row = _load_probe().identity_prefix(
         "automerge-paper", 60, fuse_w=6, chunk=64)

@@ -25,6 +25,12 @@ def _load_probe():
     return mod
 
 
+# Slow tier since PR 17 (wall budget: ~37 s of the 870 s gate): the
+# probe-smoke pattern keeps tier-1 representatives in the device-
+# prefill, flow, and lint-sanitize probe smokes; pipelined serve
+# byte-identity itself stays tier-1 in test_serve_pipeline /
+# test_serve_train.
+@pytest.mark.slow
 def test_probe_smoke_path_green():
     out = _load_probe().run_matrix(smoke=True, reps=1)
     p = out["pipeline"]

@@ -55,10 +55,15 @@ def assert_fast_exact(txns, capacity=512):
                           np.asarray(serial.signed))
 
 
+@pytest.mark.slow
 class TestTier1Smoke:
-    """Tier-1 representative of the fast-vs-serial property (the full
-    matrix below runs under ``-m slow``): one small storm with deletes
-    through both scan paths, bit-identical and oracle-equal."""
+    """Representative of the fast-vs-serial property (the full matrix
+    below also runs under ``-m slow``): one small storm with deletes
+    through both scan paths, bit-identical and oracle-equal.  Demoted
+    from tier-1 (PR 17 wall budget: ~47 s, the suite was brushing the
+    870 s gate timeout); the fast scan path keeps tier-1 coverage
+    through the ``test_rle_lanes_mixed`` tiling/growth suites and the
+    serve-lanes backend tests, which drive the same engine."""
 
     def test_small_delete_storm(self):
         txns, receiver = make_storm(3, 4, 2, seed=7, del_prob=0.3)

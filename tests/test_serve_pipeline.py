@@ -13,6 +13,7 @@ mid-run evict->restore ride along, because that is where a deferred
 sync could plausibly leak state across the checkpoint boundary.
 """
 import jax
+import pytest
 
 jax.config.update("jax_platforms", "cpu")
 
@@ -183,12 +184,17 @@ def test_lanes_pipelined_depth2_byte_identical_under_faults():
     assert rep_p["pipeline"]["overlap_frac"] > 0.0
 
 
+@pytest.mark.slow
 def test_lanes_mid_run_evict_restore_depth_equivalence():
     """The lanes backend's depth-2 evict->restore boundary: a forced
     mid-run evict while a tick may be in flight, then a restore (the
     per-lane blocked reseed) — strings, traces and flow census
     identical to the serial run (the residency-fresh mask keeps the
-    lagged true-up from resurrecting pre-upload row counts)."""
+    lagged true-up from resurrecting pre-upload row counts).  Slow
+    tier since PR 17 (wall budget: ~42 s): the evict->restore boundary
+    keeps tier-1 coverage through the flat backend's train/pipeline
+    equivalence tests (tests/test_serve_train.py) and the lanes
+    pipelined depth-2 byte-identity test above."""
     strings_p, flow_p, trace_p, srv_p = _direct_server_run(
         2, engine="rle-lanes-mixed")
     strings_s, flow_s, trace_s, srv_s = _direct_server_run(

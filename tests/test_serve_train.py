@@ -1,0 +1,372 @@
+"""Tick-train-vs-serial equivalence (ISSUE 20 tentpole).
+
+Tick trains (``ServeConfig.train_ticks`` > 1) buffer T ticks' op
+tensors + prefill-delta scatters and replay them as ONE device
+``lax.scan`` program, collapsing T dispatch overheads into one.  The
+contract that makes the scheduler safe to ship: train length moves
+WALL TIME ONLY — same-seed runs at any train length must emit
+byte-identical logical trace streams (flow spans included), identical
+green conservation audits, and identical logical counters, under 10%
+faults, forced mid-run evict->restore, and a crash at a train boundary
+(the PR 16 chaos harness).  Plus the fixed-shape discipline: train
+lengths pad to a small power-of-two series so steady state never
+recompiles, and the device overflow flag is defense in depth behind
+the pending-aware host-mirror capacity gate.
+"""
+import dataclasses
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from text_crdt_rust_tpu.config import ServeConfig  # noqa: E402
+from text_crdt_rust_tpu.ops import batch as B  # noqa: E402
+from text_crdt_rust_tpu.ops import flat as F  # noqa: E402
+from text_crdt_rust_tpu.ops import span_arrays as SA  # noqa: E402
+from text_crdt_rust_tpu.serve.batcher import FlatLaneBackend  # noqa: E402
+from text_crdt_rust_tpu.serve.loadgen import ServeLoadGen  # noqa: E402
+from text_crdt_rust_tpu.serve.server import DocServer  # noqa: E402
+
+LOGICAL_KEYS = ("item_ops_applied", "rejected_submissions",
+                "drain_rounds")
+LOGICAL_TICK_KEYS = ("steps_total", "steps_prefuse", "fused_rows_saved",
+                     "ops_per_step", "device_compiles")
+LOGICAL_SRV_KEYS = ("device_ticks", "device_steps", "evictions",
+                    "restores", "admitted", "ckpt_bytes_written")
+
+
+def _loadgen_run(train_ticks: int, docs: int = 8, ticks: int = 10):
+    # The sanitizer rides the train arms: buffered tensors are held
+    # across ticks, exactly the aliasing window it watches.
+    cfg = ServeConfig(engine="flat", num_shards=2, lanes_per_shard=4,
+                      pipeline_ticks=2, train_ticks=train_ticks,
+                      trace_keep=True, sanitize_pipeline=train_ticks > 1,
+                      flow_sample_mod=1)
+    gen = ServeLoadGen(docs=docs, agents_per_doc=2, ticks=ticks,
+                       events_per_tick=12, fault_rate=0.10, seed=7,
+                       cfg=cfg)
+    rep = gen.run()
+    return rep, gen.server.tracer.logical_bytes()
+
+
+def test_train_vs_serial_byte_identical_under_faults():
+    """The tentpole contract: depths 1/2/4 under 10% faults — logical
+    streams, flow census and the ledger-gated counters identical; only
+    the dispatch economy (and wall) moves."""
+    runs = {t: _loadgen_run(t) for t in (1, 2, 4)}
+    rep_1, trace_1 = runs[1]
+    for t, (rep, trace) in runs.items():
+        assert rep["converged"], t
+        assert trace == trace_1, \
+            f"logical stream must be train-length-invariant (depth {t})"
+        assert rep["flow"]["audit_ok"], rep["flow"]["findings"][:4]
+        assert rep["flow"]["spans"] == rep_1["flow"]["spans"]
+        assert rep["flow"]["ages_ticks"] == rep_1["flow"]["ages_ticks"]
+        for key in LOGICAL_KEYS:
+            assert rep[key] == rep_1[key], key
+        for key in LOGICAL_TICK_KEYS:
+            assert rep["tick_ms"][key] == rep_1["tick_ms"][key], key
+        for key in LOGICAL_SRV_KEYS:
+            assert rep["server"].get(key) == rep_1["server"].get(key), key
+        assert rep["wire"] == rep_1["wire"]
+        assert rep["train"]["ticks"] == t
+    # Depth 1 is exactly the serial dispatch economy; deeper trains cut
+    # dispatches/tick (partial flushes keep the small-shape cut < T).
+    assert runs[1][0]["train"]["dispatch_cut_x"] == 1.0
+    assert runs[1][0]["train"]["train_compiles"] == 0
+    assert runs[4][0]["train"]["dispatch_cut_x"] > \
+        runs[1][0]["train"]["dispatch_cut_x"]
+    assert runs[4][0]["train"]["device_dispatches"] < \
+        runs[1][0]["train"]["device_dispatches"]
+
+
+def _direct_server_run(train_ticks: int):
+    """Direct-server drive with a FORCED mid-run evict->restore while a
+    train may be open — the residency boundary a buffered tick must not
+    smear state across."""
+    cfg = ServeConfig(engine="flat", num_shards=1, lanes_per_shard=2,
+                      pipeline_ticks=2, train_ticks=train_ticks,
+                      trace_keep=True, sanitize_pipeline=train_ticks > 1,
+                      flow_sample_mod=1)
+    server = DocServer(cfg)
+    for d in range(3):
+        server.admit_doc(f"doc{d}")
+    for i in range(4):
+        for d in range(3):
+            server.submit_local(f"doc{d}", "alice", pos=0,
+                                ins_content=f"t{i}d{d}x")
+        server.tick()
+    doc0 = server.doc_state("doc0")
+    if doc0.resident:
+        server.residency.evict(doc0)
+    for i in range(3):
+        for d in range(3):
+            server.submit_local(f"doc{d}", "alice", pos=0,
+                                ins_content=f"u{i}d{d}y")
+        server.tick()
+    server.drain()
+    assert all(server.verify_doc(f"doc{d}") for d in range(3))
+    strings = [server.doc_string(f"doc{d}") for d in range(3)]
+    flow = server.flow_summary(expect_terminal=True)
+    trace = server.tracer.logical_bytes()
+    server.close_obs()
+    return strings, flow, trace, server
+
+
+def test_mid_run_evict_restore_equivalence():
+    runs = {t: _direct_server_run(t) for t in (1, 2, 4)}
+    strings_1, flow_1, trace_1, _ = runs[1]
+    for t, (strings, flow, trace, srv) in runs.items():
+        assert strings == strings_1, t
+        assert trace == trace_1, t
+        assert flow["audit_ok"]
+        assert flow["spans"] == flow_1["spans"]
+        ev = srv.counters.summary().get("evictions")
+        assert ev == runs[1][3].counters.summary().get("evictions")
+        assert ev >= 1
+
+
+def test_recompile_guard_train_bucket_series():
+    """Steady-state discipline: every compiled train key is (T-bucket,
+    S-bucket) with T drawn from the power-of-two pad series and S from
+    the step buckets — the compile set stays additive, bounded by
+    |T buckets| x |S buckets| per backend."""
+    cfg = ServeConfig(engine="flat", num_shards=2, lanes_per_shard=4,
+                      train_ticks=4, trace_keep=True)
+    gen = ServeLoadGen(docs=8, agents_per_doc=2, ticks=12,
+                       events_per_tick=12, fault_rate=0.10, seed=7,
+                       cfg=cfg)
+    rep = gen.run()
+    assert rep["converged"]
+    t_series = {1, 2, 4}
+    s_series = set(cfg.step_buckets)
+    for b in gen.server.residency.backends:
+        for (t_bkt, s_bkt) in b.train_shapes_seen:
+            assert t_bkt in t_series, (t_bkt, s_bkt)
+            assert s_bkt in s_series, (t_bkt, s_bkt)
+        assert len(b.train_shapes_seen) <= len(t_series) * len(s_series)
+    assert rep["train"]["train_compiles"] >= 1
+
+
+def _insert_tick(i: int, ins: int, lmax: int = 4) -> B.OpTensors:
+    """One single-lane [S=1, B=1] tick: a local insert of ``ins`` chars
+    (integration details don't matter to the capacity flag — only the
+    ins_len/order_advance column sums the bounds read)."""
+    one = B.pad_ops(B.empty_ops(lmax), 1)
+    one = dataclasses.replace(
+        one,
+        ins_len=np.full((1,), ins, np.uint32),
+        order_advance=np.full((1,), ins, np.uint32),
+        ins_order_start=np.full((1,), 1 + ins * i, np.uint32),
+        rows_per_step=np.ones((1,), np.uint32))
+    return B.stack_ops([one])
+
+
+def test_capacity_flag_at_train_boundary():
+    """The device overflow flag accumulates across ALL T ticks and
+    reads true iff some tick exceeded the static bounds mid-train —
+    same bounds as ``check_capacity_counts``, evaluated per tick."""
+    docs = jax.tree.map(jnp.array,
+                        SA.stack_docs(SA.make_flat_doc(8, 64), 1))
+    ok = B.stack_ticks([_insert_tick(i, 4) for i in range(2)])
+    out, flag = F.apply_train(docs, ok)
+    assert not bool(flag)          # 8 chars == capacity 8: exactly fits
+    assert int(np.asarray(out.n)[0]) == 8
+    over = B.stack_ticks([_insert_tick(i, 4) for i in range(3)])
+    _, flag = F.apply_train(docs, over)
+    assert bool(flag)              # 12 chars > capacity 8, tick 3 of 3
+
+
+def test_pending_aware_host_gate_refuses_overflow_trains():
+    """The authoritative gate stays host-side: with ticks buffered in
+    an open train, the mirror capacity check counts the PENDING column
+    sums too, so a tick the serial loop would refuse is refused at the
+    same logical position — the device flag never fires via serve."""
+    be = FlatLaneBackend(lanes=1, capacity=8, order_capacity=64, lmax=4)
+    be.set_train_ticks(4)
+    be.apply(_insert_tick(0, 4))
+    assert len(be._train_buf) == 1     # buffered, not dispatched
+    with pytest.raises(AssertionError, match="capacity"):
+        be.apply(_insert_tick(1, 8))   # 4 pending + 8 > 8
+    be.apply(_insert_tick(1, 4))       # 4 + 4 == 8 still fits
+    be.flush_train()
+    assert int(be._n_host[0]) == 8
+    assert not be._train_buf and not be._train_flags
+
+
+def test_overflow_flag_raises_at_drain():
+    """Defense in depth: a set train flag is a contract violation (the
+    docs are corrupt, not merely full) and raises loudly at the drain
+    instead of degrading."""
+    be = FlatLaneBackend(lanes=1, capacity=8, order_capacity=64, lmax=4)
+    be._train_flags.append(jnp.asarray(True))
+    with pytest.raises(RuntimeError, match="overflow flag"):
+        be._drain_train_flags(block=True)
+
+
+def test_train_depth_clamps():
+    """Backends opt in via max_train_ticks: flat device-prefill caps at
+    8, flat host-prefill and the lanes backend stay serial (1); the
+    batcher's effective length is the min across backends."""
+    cfg = ServeConfig(engine="flat", num_shards=1, lanes_per_shard=2,
+                      train_ticks=16)
+    server = DocServer(cfg)
+    assert server.batcher.train_ticks == 16
+    assert server.batcher.effective_train_ticks() == 8
+    server.close_obs()
+    cfg_h = ServeConfig(engine="flat", num_shards=1, lanes_per_shard=2,
+                        train_ticks=4, device_prefill=False)
+    server_h = DocServer(cfg_h)
+    assert server_h.batcher.effective_train_ticks() == 1
+    server_h.close_obs()
+    cfg_l = ServeConfig(engine="rle-lanes-mixed", lane_capacity=128,
+                        lanes_block_k=8, order_capacity=512,
+                        step_buckets=(8, 32), max_txn_len=32,
+                        num_shards=1, lanes_per_shard=2, train_ticks=4)
+    server_l = DocServer(cfg_l)
+    assert server_l.batcher.effective_train_ticks() == 1
+    server_l.close_obs()
+
+
+def test_train_bucket_pow2_series():
+    """Partial trains re-use bucketed programs: the pad series is the
+    smallest power of two >= the flushed length."""
+    for t, want in ((1, 1), (2, 2), (3, 4), (4, 4), (5, 8), (8, 8)):
+        assert FlatLaneBackend._train_bucket(t) == want, t
+
+
+def test_stack_ticks_train_major_shapes():
+    """[S, B] ticks stack to a train-major [T, S, B] batch with dtypes
+    and per-tick contents preserved."""
+    ticks = [_insert_tick(i, 4) for i in range(3)]
+    train = B.stack_ticks(ticks)
+    for f in ("ins_len", "order_advance", "rows_per_step"):
+        col = np.asarray(getattr(train, f))
+        want = np.asarray(getattr(ticks[0], f))
+        assert col.shape == (3,) + want.shape, f
+        assert col.dtype == want.dtype, f
+        for i in range(3):
+            np.testing.assert_array_equal(
+                col[i], np.asarray(getattr(ticks[i], f)), err_msg=f)
+
+
+def test_stack_ticks_noop_pad_is_exact_noop():
+    """The short-train pad contract ``_dispatch_train`` relies on: an
+    all-zero tick appended to a train leaves the post-train device
+    state bit-identical to the unpadded train."""
+    docs = jax.tree.map(jnp.array,
+                        SA.stack_docs(SA.make_flat_doc(8, 64), 1))
+    tick = _insert_tick(0, 4)
+    zero = jax.tree.map(lambda a: np.zeros_like(np.asarray(a)), tick)
+    out1, flag1 = F.apply_train(docs, B.stack_ticks([tick]))
+    out2, flag2 = F.apply_train(docs, B.stack_ticks([tick, zero]))
+    assert not bool(flag1) and not bool(flag2)
+    mismatch = jax.tree.map(
+        lambda a, b: np.array_equal(np.asarray(a), np.asarray(b)),
+        out1, out2)
+    assert all(jax.tree.leaves(mismatch))
+
+
+def test_concat_deltas_none_handling():
+    """No-insert ticks contribute nothing: all-None -> None (skip the
+    scatter dispatch entirely), a single live delta passes through."""
+    assert B.concat_deltas([None, None]) is None
+    d = B.prefill_delta(_insert_tick(0, 4))
+    assert d is not None
+    assert B.concat_deltas([None, d, None]) is d
+
+
+def test_concat_deltas_disjoint_concat_and_bucket():
+    """Two per-tick deltas concatenate in tick order and re-pad to the
+    shared scatter-bucket series (the train path draws from the SAME
+    compiled scatter set as the serial path)."""
+    d0 = B.prefill_delta(_insert_tick(0, 4))
+    d1 = B.prefill_delta(_insert_tick(1, 4))
+    cat = B.concat_deltas([d0, d1])
+    assert cat.bucket == B.scatter_bucket(d0.bucket + d1.bucket)
+    assert cat.bucket in {B.PREFILL_BUCKET_BASE * 4 ** k
+                          for k in range(6)}
+    pos = np.asarray(cat.ins_pos)
+    np.testing.assert_array_equal(pos[..., :d0.bucket],
+                                  np.asarray(d0.ins_pos))
+    np.testing.assert_array_equal(
+        pos[..., d0.bucket:d0.bucket + d1.bucket],
+        np.asarray(d1.ins_pos))
+    assert (pos[..., d0.bucket + d1.bucket:] == B.PREFILL_PAD).all()
+
+
+def test_flush_train_empty_is_noop():
+    """The pre-read sync point is safe to call with nothing buffered —
+    no dispatch, no stats, no mirror movement."""
+    be = FlatLaneBackend(lanes=1, capacity=8, order_capacity=64, lmax=4)
+    be.set_train_ticks(4)
+    before = dict(be.train_stats)
+    be.flush_train()
+    assert be.train_stats == before
+    assert int(be._n_host[0]) == 0 and not be._train_flags
+
+
+def test_train_summary_dispatch_economy_maths():
+    """The ledger-gated ride-alongs are pure arithmetic over the
+    logical dispatch counters (seed-deterministic, platform-free)."""
+    be = FlatLaneBackend(lanes=1, capacity=8, order_capacity=64, lmax=4)
+    be.set_train_ticks(4)
+    be.train_stats.update(trains=2, ticks_sum=4, dispatches=3,
+                          serial_equiv=8)
+    s = be.train_summary()
+    assert s["device_dispatches"] == 3
+    assert s["dispatch_cut_x"] == round(8 / 3, 2)
+    assert s["train_len"] == 2.0
+    assert s["train_ticks"] == 4
+
+
+def test_serial_path_unchanged_at_depth_one():
+    """train_ticks=1 (the default) takes the exact pre-train serial
+    path: no buffering, one tick -> immediate dispatch, mirrors advance
+    by the tick's column sums, no train programs compiled."""
+    be = FlatLaneBackend(lanes=1, capacity=8, order_capacity=64, lmax=4)
+    assert be.train_ticks == 1
+    be.apply(_insert_tick(0, 4))
+    assert not be._train_buf and not be._train_flags
+    assert int(be._n_host[0]) == 4
+    assert int(be._next_order_host[0]) == 4
+    assert be.train_summary()["dispatch_cut_x"] == 1.0
+    assert be.train_summary()["train_compiles"] == 0
+
+
+@pytest.mark.slow
+def test_crash_at_train_boundary_recovery():
+    """PR 16 interplay, loud half: kill the server right after a tick
+    that closes a train (post-dispatch), recover from the journal,
+    resume, and match an uncrashed same-seed twin byte for byte."""
+    from text_crdt_rust_tpu.serve.chaos import run_crash_scenario
+
+    cell = run_crash_scenario("post-dispatch", 4, ticks=10, docs=8,
+                              agents_per_doc=2, events_per_tick=10,
+                              seed=11, fault_rate=0.10, train_ticks=2)
+    assert cell["identical"], (cell["digest"], cell["twin_digest"])
+    assert cell["converged"] and cell["twin_converged"]
+    assert cell["at_recovery_audit"]["audit_ok"]
+    assert cell["final_audit"]["audit_ok"]
+
+
+@pytest.mark.slow
+def test_recovery_replays_across_train_lengths():
+    """The journal-interplay satellite: a journal written at
+    train_ticks=2 recovers sha-identical on a server configured at a
+    DIFFERENT train length (4) — per-tick journal markers make train
+    length a pure wall-clock knob end to end."""
+    from text_crdt_rust_tpu.serve.chaos import run_crash_scenario
+
+    cell = run_crash_scenario("post-dispatch", 4, ticks=10, docs=8,
+                              agents_per_doc=2, events_per_tick=10,
+                              seed=11, fault_rate=0.10, train_ticks=2,
+                              recover_train_ticks=4)
+    assert cell["identical"], (cell["digest"], cell["twin_digest"])
+    assert cell["converged"] and cell["twin_converged"]
+    assert cell["at_recovery_audit"]["audit_ok"]
+    assert cell["final_audit"]["audit_ok"]

@@ -15,6 +15,11 @@ def test_load_sveltecomponent():
     assert len(data.end_content) == 18_451
 
 
+# Slow tier since PR 17 (wall budget: ~21 s of the 870 s gate —
+# full-corpus decompress + per-patch walk); corpus loading keeps
+# tier-1 coverage via test_load_sveltecomponent and the automerge
+# prefix replay below.
+@pytest.mark.slow
 def test_load_automerge_paper_counts():
     data = load_testing_data(trace_path("automerge-paper"))
     assert len(data.txns) == 259_778

@@ -35,6 +35,16 @@ drive; this check makes the pairing a LINT contract:
   ``MIRROR_CONTRACTS``: a new lane backend landed without declaring
   its mirror contract.  Register it (or grant the scope) so M001 can
   watch its write sites.
+
+- **TCR-M003** — tick trains (ISSUE 20) defer T device writes behind a
+  buffered train, and the mirrors true up by the buffered column sums
+  at the TRAIN boundary.  That true-up site is registered per class
+  (``train_sync``), and the contract is ATOMICITY: the registered
+  method must perform the device write AND a mirror write directly in
+  its own body — no one-level helper delegation, which M001 would
+  accept.  Splitting them re-opens the exact drift M001 exists to
+  prevent, but across a boundary where T ticks of occupancy move at
+  once (a partial true-up is T ticks wrong, not one).
 """
 from __future__ import annotations
 
@@ -50,6 +60,9 @@ MIRROR_CONTRACTS = {
     "FlatLaneBackend": {
         "device": ("docs",),
         "mirror": ("_n_host", "_next_order_host"),
+        # TCR-M003: the train-boundary mirror true-up must live in the
+        # same method as the train's device write (see module header).
+        "train_sync": ("_dispatch_train",),
     },
     "LanesMixedLaneBackend": {
         "device": ("_state",),
@@ -63,6 +76,7 @@ MIRROR_CONTRACTS = {
 DEFAULT_PRODUCERS = frozenset({
     "apply_prefill_delta", "_scatter_delta", "_scatter_delta_batch",
     "_apply_ops", "_apply_ops_batch", "apply_ops", "apply_ops_batch",
+    "apply_train", "_apply_train_batch",
     "prefill_logs", "step",
 })
 
@@ -225,6 +239,33 @@ def check(ctx: FileContext,
         mirrors = set(contract["mirror"])
         mirror_methods = {name for name, m in sorted(methods.items())
                           if _method_mirror_writes(m, mirrors)}
+        # Registered train-boundary sync sites are their own contract
+        # (TCR-M003) and do NOT excuse other methods via the one-level
+        # pairing rule: the serial tick path calls the train dispatcher
+        # on the enqueue branch, so cutting the serial true-up would
+        # otherwise hide behind the train helper's mirror writes.
+        pairing = mirror_methods - set(contract.get("train_sync", ()))
+        # TCR-M003: registered train-boundary sync sites must be atomic
+        # — device write AND mirror true-up directly in the one method.
+        for name in contract.get("train_sync", ()):
+            m = methods.get(name)
+            if m is None:
+                continue
+            writes = _method_device_writes(m, device)
+            if not writes:
+                writes = [c for c in stmt_calls(m)
+                          if call_leaf(c) in producers]
+            if writes and name in mirror_methods:
+                continue
+            out.append(ctx.finding(
+                "TCR-M003", writes[0] if writes else m,
+                f"{node.name}.{name} is the registered train-boundary "
+                f"sync site but does not perform the device write and "
+                f"the mirror true-up ({', '.join(sorted(mirrors))}) in "
+                f"its own body — the train contract is atomic: T "
+                f"ticks' occupancy moves in one method, no helper "
+                f"delegation (a split true-up drifts T ticks at a "
+                f"time)"))
         for name, m in sorted(methods.items()):
             writes = _method_device_writes(m, device)
             # a producer call on its own marks the method too (a
@@ -238,7 +279,7 @@ def check(ctx: FileContext,
                 continue
             if name in mirror_methods:
                 continue
-            if _self_method_calls(m) & mirror_methods:
+            if _self_method_calls(m) & pairing:
                 continue  # one-level pairing via a same-class helper
             writes.sort(key=lambda n: getattr(n, "lineno", 0))
             out.append(ctx.finding(

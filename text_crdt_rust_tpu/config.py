@@ -246,6 +246,30 @@ class ServeConfig:
     #                            row counts at its barrier, so it
     #                            stays serial until that true-up is
     #                            pipeline-safe)
+    train_ticks: int = 1       # device tick-train length (ISSUE 20):
+    #                            T > 1 = the batcher accumulates T
+    #                            ticks' fixed-shape op tensors (+ their
+    #                            prefill-delta scatters, concatenated)
+    #                            and dispatches them as ONE jitted
+    #                            lax.scan program (ops.flat.apply_
+    #                            train), collapsing T dispatch
+    #                            overheads into one; 1 = today's
+    #                            one-dispatch-per-tick loop.  Train
+    #                            lengths are padded to powers of two
+    #                            ({1,2,4,8}) so steady state never
+    #                            recompiles, and the compile set stays
+    #                            additive (|S buckets| x |T buckets| +
+    #                            |scatter buckets|).  Logical streams
+    #                            are byte-identical at any length —
+    #                            like pipeline_ticks, a pure wall-clock
+    #                            knob (pinned by tests/test_serve_
+    #                            train.py).  Backends opt in via
+    #                            ``max_train_ticks``: the flat backend
+    #                            accepts up to 8 on its device-prefill
+    #                            path (host prefill needs per-tick host
+    #                            log writes, incompatible with
+    #                            deferral); the blocked lanes backend
+    #                            stays at 1 (barrier true-up)
     sanitize_pipeline: bool = False  # pipeline aliasing sanitizer
     #                            (ISSUE 13): fingerprint (CRC32) the op
     #                            tensors referenced by each in-flight

@@ -1145,6 +1145,39 @@ def prefill_delta(ops: OpTensors) -> Optional[PrefillDelta]:
     return PrefillDelta(*cols, bucket=L)
 
 
+def concat_deltas(deltas) -> Optional[PrefillDelta]:
+    """Concatenate T per-tick ``PrefillDelta``s (same batch shape) into
+    one padded delta for a tick train (ISSUE 20).  Per-tick scatter
+    positions land in disjoint fresh order ranges (orders are allocated
+    uniquely and monotonically per lane), so applying the concatenation
+    once before the train scan is bit-identical to applying each delta
+    before its tick.  ``None`` entries (no-insert ticks) contribute
+    nothing; returns ``None`` when every tick was insert-free.  The
+    result is re-padded to the ``scatter_bucket`` series, so the train
+    path draws from the SAME compiled scatter set as the serial path."""
+    live = [d for d in deltas if d is not None]
+    if not live:
+        return None
+    if len(live) == 1:
+        return live[0]
+    total = sum(d.bucket for d in live)
+    L = scatter_bucket(total)
+    fields = ("ins_pos", "chars_val", "rank_val", "ol_pos", "ol_val",
+              "or_pos", "or_val")
+    pads = {"ins_pos": PREFILL_PAD, "ol_pos": PREFILL_PAD,
+            "or_pos": PREFILL_PAD}
+    cols = []
+    for f in fields:
+        col = np.concatenate(
+            [np.asarray(getattr(d, f)) for d in live], axis=-1)
+        if col.shape[-1] < L:
+            width = [(0, 0)] * (col.ndim - 1) + [(0, L - col.shape[-1])]
+            col = np.pad(col, width,
+                         constant_values=pads.get(f, np.uint32(0)))
+        cols.append(col)
+    return PrefillDelta(*cols, bucket=L)
+
+
 def row_growth_bound(num_steps: int) -> int:
     """Sound per-lane run-row bound after ``num_steps`` compiled device
     steps: every step splices at most 2 new rows (insert splice / delete
@@ -1214,6 +1247,18 @@ def stack_ops(streams: Sequence[OpTensors]) -> OpTensors:
     s_max = max(o.num_steps for o in streams)
     padded = [pad_ops(o, s_max) for o in streams]
     return jax.tree.map(lambda *xs: np.stack(xs, axis=1), *padded)
+
+
+def stack_ticks(ticks: Sequence[OpTensors]) -> OpTensors:
+    """T equal-shape stacked tick streams ([S, B, ...] each) -> one
+    train-major [T, S, B, ...] tensor batch for ``ops.flat.apply_train``
+    (ISSUE 20).  The caller re-pads every tick to a common step bucket
+    first (``pad_ops``) and pads short trains with all-zero no-op ticks
+    — a zero ``OpTensors`` row is an exact no-op in the device step, so
+    no-op ticks are exact no-op ticks."""
+    return jax.tree.map(
+        lambda *xs: np.stack([np.asarray(x) for x in xs], axis=0),
+        *ticks)
 
 
 def tile_ops(ops: OpTensors, batch: int) -> OpTensors:

@@ -397,6 +397,78 @@ def _apply_ops_batch(docs: FlatDoc, ops: OpTensors, local_only: bool = False
     return out
 
 
+# -- tick trains (ISSUE 20) ---------------------------------------------------
+# T ticks' stacked op tensors replayed as ONE device program: an outer
+# ``lax.scan`` over the tick axis wrapping the inner per-tick scan of
+# vmapped steps.  The compile cache is keyed by (T bucket, S bucket,
+# B, CAP, OCAP, LMAX) — the serve scheduler pads T to a small geometric
+# series (powers of two) and re-pads S to the train's max step bucket,
+# so the steady-state compile set stays ADDITIVE: |S buckets| x |T
+# buckets| train programs + |scatter buckets| scatter programs (the
+# concatenated prefill scatter stays a SEPARATE dispatch — folding it
+# in would multiply the key space by |scatter buckets|).
+#
+# The capacity/overflow flag is accumulated ON DEVICE (one bool across
+# all T ticks and all lanes) and checked once at the train boundary —
+# the host-mirror capacity check (``check_capacity_counts`` against the
+# backend's pending-aware mirrors) remains the authoritative gate at
+# enqueue time; the device flag is defense in depth.
+
+
+@partial(jax.jit, static_argnames=("local_only",))
+def _apply_train_batch(docs: FlatDoc, ops: OpTensors,
+                       local_only: bool = False):
+    """``ops`` leaves are train-major [T, S, B, ...]; returns
+    ``(docs, overflow_flag)`` where the flag mirrors the
+    ``check_capacity_counts`` bounds evaluated after every tick."""
+    cap = docs.signed.shape[-1]
+    ocap = docs.ol_log.shape[-1]
+    lmax = ops.chars.shape[-1]
+    vstep = jax.vmap(partial(step, local_only=local_only))
+
+    def tick_body(carry, tick_ops):
+        d, flag = carry
+
+        def body(dd, op):
+            # A step that is idle on EVERY lane is tick/step padding
+            # (the all-zero no-op contract of ``batch.pad_ops``); a
+            # scalar cond skips its whole-batch compute.  Re-padding a
+            # train's ticks to a common step bucket would otherwise run
+            # each short tick at the longest tick's step count — at
+            # mixed-bucket shapes that inflates padded device steps
+            # ~1.5-2.4x over the serial loop and erases the dispatch
+            # win on wall clock.
+            active = (jnp.any(op.rows_per_step > 0)
+                      | jnp.any(op.ins_len > 0)
+                      | jnp.any(op.del_len > 0))
+            return lax.cond(active, lambda s: vstep(s, op),
+                            lambda s: s, dd), None
+
+        d, _ = lax.scan(body, d, tick_ops)
+        flag = (flag | jnp.any(d.n > cap)
+                | jnp.any(d.next_order > ocap - lmax))
+        return (d, flag), None
+
+    (out, flag), _ = lax.scan(tick_body, (docs, jnp.asarray(False)), ops)
+    return out, flag
+
+
+def apply_train(docs: FlatDoc, ops: OpTensors):
+    """Apply a tick train — [T, S, B, ...] op tensors (``batch.
+    stack_ticks`` of T stacked tick streams) — to batched docs in ONE
+    dispatch.  The caller must have applied the train's concatenated
+    prefill delta first (``batch.concat_deltas`` + ``apply_prefill_
+    delta``): per-tick scatters land in disjoint fresh order ranges
+    (orders are allocated uniquely and monotonically per lane), so
+    hoisting them all before the scan is bit-identical to interleaving.
+    Returns ``(docs, overflow_flag)``; a set flag means a tick exceeded
+    the static capacities mid-train and the docs are corrupt — the
+    serve backend's pending-aware host-mirror check refuses such trains
+    at enqueue, so a set flag is a contract violation, not flow
+    control."""
+    return _apply_train_batch(docs, ops, local_only=False)
+
+
 def _is_local_only(ops: OpTensors) -> bool:
     return bool(np.all(np.asarray(ops.kind) == KIND_LOCAL))
 

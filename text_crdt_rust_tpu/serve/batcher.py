@@ -147,6 +147,39 @@ class FlatLaneBackend:
         # deterministic) — wall-free by the §15 cpu-cell rule.
         self.prefill_stats = {"ticks": 0, "moved_bytes": 0,
                               "full_bytes_equiv": 0, "scatter_len": 0}
+        # Tick trains (ISSUE 20): with train_ticks > 1 ``apply`` buffers
+        # ticks host-side (op tensors + prefill deltas, both already
+        # fixed-shape) and ``_dispatch_train`` replays T of them as ONE
+        # device program (``ops.flat.apply_train``), the concatenated
+        # scatter staying a separate dispatch so the compile set stays
+        # additive.  Only the device-prefill path can defer: host
+        # prefill writes the [B, OCAP] logs per tick from host numpy,
+        # which would race the deferred scan.  The host mirrors advance
+        # at the TRAIN boundary by the buffered column sums
+        # (``_pending_n``/``_pending_o``) — the TCR-M003 train_sync
+        # contract: device write and mirror true-up in ONE method.
+        self.max_train_ticks = 8 if device_prefill else 1
+        self.train_ticks = 1
+        self._train_buf: list = []      # [(stacked, delta), ...]
+        self._pending_n = np.zeros(lanes, np.int64)
+        self._pending_o = np.zeros(lanes, np.int64)
+        # Which lanes have real (non-padding) steps buffered: lanes are
+        # independent under the vmapped step, so a single-lane
+        # residency write (upload / rank remap) only forces a flush
+        # when THAT lane has buffered work — without this gate,
+        # mid-stream agent onboarding (``_grow_table`` rank remaps)
+        # would flush nearly every train at length 1.
+        self._pending_active = np.zeros(lanes, bool)
+        self._train_flags: list = []    # in-flight device overflow flags
+        self.train_shapes_seen: set = set()  # compiled (T, S) train keys
+        self.train_stats = {"trains": 0, "ticks_sum": 0,
+                            "dispatches": 0, "serial_equiv": 0}
+
+    def set_train_ticks(self, t: int) -> None:
+        """Clamp-and-set the effective train length (the batcher calls
+        this at construction with ``ServeConfig.train_ticks``; backends
+        cap it at their ``max_train_ticks``)."""
+        self.train_ticks = max(1, min(int(t), self.max_train_ticks))
 
     def fits(self, n: int, next_order: int) -> bool:
         """Would a doc of ``n`` rows / ``next_order`` orders fit a lane
@@ -170,7 +203,80 @@ class FlatLaneBackend:
         bound the stream's splice growth per active op branch."""
         return self.fits(oracle.n, oracle.get_next_order())
 
+    def _flush_train_for_lane(self, b: int) -> None:
+        """Flush the open train iff lane ``b`` has buffered steps:
+        lanes are independent columns of the vmapped step, so a
+        residency write to a lane with NO buffered work commutes with
+        the rest of the train (its delta columns are all padding, its
+        step rows all no-ops) — serial order is preserved per lane,
+        which is the only order the logical stream observes."""
+        if self._pending_active[b]:
+            self.flush_train()
+
+    def _remap_buffered_lane(self, b: int, m: np.ndarray) -> None:
+        """Re-base lane ``b``'s buffered rank values through the
+        old->new rank map (same guard as the device rewrite: values
+        outside the map — padding, sentinels — pass through).  Copies
+        the touched arrays: the originals may be CRC-fingerprinted by
+        the pipeline sanitizer, and in-place writes would read as
+        aliasing."""
+        if not self._pending_active[b]:
+            return
+        import dataclasses
+        mlen = m.shape[0]
+
+        def rebase(col):
+            safe = np.minimum(col, mlen - 1)
+            return np.where(col < mlen, m[safe], col)
+
+        for i, (stacked, delta) in enumerate(self._train_buf):
+            r = np.asarray(stacked.rank).copy()
+            r[:, b] = rebase(r[:, b])
+            stacked = dataclasses.replace(stacked, rank=r)
+            if delta is not None:
+                rv = np.asarray(delta.rank_val).copy()
+                rv[b] = rebase(rv[b])
+                delta = dataclasses.replace(delta, rank_val=rv)
+            self._train_buf[i] = (stacked, delta)
+
+    def _cancel_buffered_lane(self, b: int) -> None:
+        """Erase lane ``b``'s columns from every buffered tick: zero op
+        rows (an exact no-op step) and padding delta positions (dropped
+        by the scatter).  Used by ``clear_lane``: eviction checkpoints
+        from the ORACLE, so the serial loop's apply-then-wipe of the
+        device lane and the train path's never-apply are
+        indistinguishable — nothing reads the lane in between."""
+        if not self._pending_active[b]:
+            return
+        import dataclasses
+        for i, (stacked, delta) in enumerate(self._train_buf):
+            cols = {}
+            for f in ("kind", "pos", "del_len", "del_target",
+                      "origin_left", "origin_right", "ins_len",
+                      "ins_order_start", "order_advance", "rank",
+                      "rows_per_step", "chars"):
+                a = np.asarray(getattr(stacked, f)).copy()
+                a[:, b] = 0
+                cols[f] = a
+            stacked = dataclasses.replace(stacked, **cols)
+            if delta is not None:
+                dcols = {}
+                for f in ("ins_pos", "ol_pos", "or_pos"):
+                    a = np.asarray(getattr(delta, f)).copy()
+                    a[b] = B.PREFILL_PAD
+                    dcols[f] = a
+                for f in ("chars_val", "rank_val", "ol_val", "or_val"):
+                    a = np.asarray(getattr(delta, f)).copy()
+                    a[b] = 0
+                    dcols[f] = a
+                delta = dataclasses.replace(delta, **dcols)
+            self._train_buf[i] = (stacked, delta)
+        self._pending_n[b] = 0
+        self._pending_o[b] = 0
+        self._pending_active[b] = False
+
     def clear_lane(self, b: int) -> None:
+        self._cancel_buffered_lane(b)
         self.docs = jax.tree.map(
             lambda batched, one: batched.at[b].set(one),
             self.docs, self._empty)
@@ -178,6 +284,7 @@ class FlatLaneBackend:
         self._next_order_host[b] = 0
 
     def upload_lane(self, b: int, oracle, rank_of_agent) -> None:
+        self._flush_train_for_lane(b)
         flat = SA.upload_oracle(oracle, self.capacity, rank_of_agent,
                                 self.order_capacity)
         self.docs = jax.tree.map(
@@ -186,6 +293,16 @@ class FlatLaneBackend:
         self._next_order_host[b] = oracle.get_next_order()
 
     def remap_lane_ranks(self, b: int, mapping: np.ndarray) -> None:
+        # Buffered work for THIS lane carries rank values baked with
+        # the PRE-remap table (the op tensors' author ``rank`` column
+        # and the prefill deltas' ``rank_val``); re-base them through
+        # the same old->new map instead of flushing.  The map is
+        # strictly monotone on old ranks (sorted-name order is stable
+        # under growth), so every tiebreak comparison the buffered
+        # steps will make is preserved — mid-stream onboarding would
+        # otherwise flush nearly every train (``_grow_table`` fires on
+        # each doc's late-arriving agents).
+        self._remap_buffered_lane(b, np.asarray(mapping, dtype=np.uint32))
         import dataclasses
 
         import jax.numpy as jnp
@@ -203,8 +320,12 @@ class FlatLaneBackend:
         same per-lane pairing, zero device reads (the mirrors are
         exact: every accepted tick advances n by its ins_len column
         sum and next_order by its order_advance sum, residency writes
-        reset them from the oracle)."""
-        F.check_capacity_counts(self._n_host, self._next_order_host,
+        reset them from the oracle).  With ticks buffered in an open
+        train, the not-yet-trued-up pending sums count too — the check
+        gates against the post-TRAIN occupancy, so a train can never
+        carry a tick the serial loop would have refused."""
+        F.check_capacity_counts(self._n_host + self._pending_n,
+                                self._next_order_host + self._pending_o,
                                 self.capacity, self.order_capacity, ops)
 
     def apply(self, stacked: B.OpTensors) -> None:
@@ -228,6 +349,27 @@ class FlatLaneBackend:
         st["scatter_len"] += int(np.asarray(
             stacked.ins_len, dtype=np.int64).sum())
         self.shapes_seen.add(int(stacked.num_steps))
+        if self.train_ticks > 1:
+            # Tick-train path (ISSUE 20; device_prefill guaranteed —
+            # ``max_train_ticks`` clamps host-prefill backends to 1):
+            # gate against the pending-aware host mirrors NOW (serial
+            # admission semantics), buffer the tick, and dispatch ONE
+            # ``apply_train`` program once train_ticks are queued.
+            self._check_capacity_host(stacked)
+            delta = B.prefill_delta(stacked)
+            self._train_buf.append((stacked, delta))
+            self._pending_n += np.asarray(
+                stacked.ins_len, dtype=np.int64).sum(axis=0)
+            self._pending_o += np.asarray(
+                stacked.order_advance, dtype=np.int64).sum(axis=0)
+            self._pending_active |= (np.asarray(
+                stacked.rows_per_step, dtype=np.int64).sum(axis=0) > 0)
+            self.train_stats["serial_equiv"] += 1 + (delta is not None)
+            self._drain_train_flags()
+            if len(self._train_buf) >= self.train_ticks:
+                self._dispatch_train()
+            return
+        n_disp = 1
         if self.device_prefill:
             self._check_capacity_host(stacked)
             delta = B.prefill_delta(stacked)
@@ -236,6 +378,7 @@ class FlatLaneBackend:
                 self.scatter_shapes_seen.add(delta.bucket)
                 st["moved_bytes"] += delta.nbytes()
                 docs = F.apply_prefill_delta(docs, delta)
+                n_disp = 2
         else:
             F._check_capacity(self.docs, stacked)
             docs = B.prefill_logs(self.docs, stacked)
@@ -246,6 +389,116 @@ class FlatLaneBackend:
             stacked.ins_len, dtype=np.int64).sum(axis=0)
         self._next_order_host += np.asarray(
             stacked.order_advance, dtype=np.int64).sum(axis=0)
+        ts = self.train_stats
+        ts["trains"] += 1
+        ts["ticks_sum"] += 1
+        ts["dispatches"] += n_disp
+        ts["serial_equiv"] += n_disp
+
+    @staticmethod
+    def _train_bucket(t: int) -> int:
+        """Smallest power of two >= ``t`` — the train-length pad series
+        ({1, 2, 4, 8}): partial trains (flushes) re-use a bucketed
+        program instead of compiling per ragged length."""
+        b = 1
+        while b < t:
+            b *= 2
+        return b
+
+    def _dispatch_train(self) -> None:
+        """Replay the buffered ticks as ONE device train: (1) the
+        concatenated prefill scatter (separate dispatch — keeping it
+        out of the scan keeps the compile set additive, |S|x|T| + |L|),
+        (2) one ``apply_train`` scan over the [T, S, B] stack.  Per-tick
+        scatters land in disjoint fresh order ranges, so hoisting the
+        concatenation before the scan is bit-identical to the serial
+        interleaving.  Host mirrors true up by the buffered column sums
+        HERE, in the same method as the device write — the TCR-M003
+        ``train_sync`` atomicity contract."""
+        buf, self._train_buf = self._train_buf, []
+        if not buf:
+            return
+        st = self.prefill_stats
+        ts = self.train_stats
+        t_bkt = self._train_bucket(len(buf))
+        s_max = max(s.num_steps for s, _ in buf)
+        ticks = [B.pad_ops(s, s_max) for s, _ in buf]
+        if len(ticks) < t_bkt:
+            noop = jax.tree.map(
+                lambda a: np.zeros_like(np.asarray(a)), ticks[0])
+            ticks = ticks + [noop] * (t_bkt - len(ticks))
+        train = B.stack_ticks(ticks)
+        delta = B.concat_deltas([d for _, d in buf])
+        docs = self.docs
+        n_disp = 1
+        if delta is not None:
+            self.scatter_shapes_seen.add(delta.bucket)
+            st["moved_bytes"] += delta.nbytes()
+            docs = F.apply_prefill_delta(docs, delta)
+            n_disp = 2
+        docs, flag = F.apply_train(docs, train)
+        self.docs = docs
+        self._train_flags.append(flag)
+        self.train_shapes_seen.add((t_bkt, s_max))
+        ts["trains"] += 1
+        ts["ticks_sum"] += len(buf)
+        ts["dispatches"] += n_disp
+        self._n_host = self._n_host + self._pending_n
+        self._next_order_host = self._next_order_host + self._pending_o
+        self._pending_n = np.zeros(self.lanes, np.int64)
+        self._pending_o = np.zeros(self.lanes, np.int64)
+        self._pending_active = np.zeros(self.lanes, bool)
+
+    def _drain_train_flags(self, block: bool = False) -> None:
+        """Check completed trains' device overflow flags.  Non-blocking
+        by default (opportunistic, at enqueue); ``block=True`` forces
+        every in-flight train to completion (barrier / flush).  A set
+        flag means a tick exceeded the static capacities mid-train —
+        unreachable through the serve path (the pending-aware host
+        check refuses such ticks at enqueue), so it raises instead of
+        degrading: the docs are corrupt, not merely full."""
+        keep = []
+        for flag in self._train_flags:
+            if not block and hasattr(flag, "is_ready") \
+                    and not flag.is_ready():
+                keep.append(flag)
+                continue
+            if bool(np.asarray(flag)):
+                raise RuntimeError(
+                    "tick-train overflow flag set: a train exceeded the "
+                    "lane capacity/order budget on device; the host-"
+                    "mirror capacity check should have refused it at "
+                    "enqueue")
+        self._train_flags = keep
+
+    def flush_train(self) -> None:
+        """Dispatch any partial train and settle its overflow flags —
+        the pre-read / pre-residency-write sync point (``lane_doc``,
+        ``clear_lane``/``upload_lane``/``remap_lane_ranks``, pipeline
+        flush).  NOT called from ``barrier``: at pipeline depth 1 the
+        batcher barriers every tick, which would stop trains from ever
+        forming."""
+        if self._train_buf:
+            self._dispatch_train()
+        self._drain_train_flags(block=True)
+
+    def train_summary(self) -> Dict[str, float]:
+        """Per-train dispatch economy (logical, seed-deterministic):
+        how many device dispatches the tick stream cost vs what the
+        serial loop would have issued, and the train program compile
+        count (report-only — never traced, so the logical stream stays
+        train-length-invariant)."""
+        ts = self.train_stats
+        return {
+            "train_ticks": self.train_ticks,
+            "device_dispatches": ts["dispatches"],
+            "dispatch_serial_equiv": ts["serial_equiv"],
+            "dispatch_cut_x": round(
+                ts["serial_equiv"] / max(ts["dispatches"], 1), 2),
+            "train_len": round(
+                ts["ticks_sum"] / max(ts["trains"], 1), 2),
+            "train_compiles": len(self.train_shapes_seen),
+        }
 
     def prefill_summary(self) -> Dict[str, float]:
         """Per-tick prefill byte economy (logical, seed-deterministic):
@@ -266,6 +519,12 @@ class FlatLaneBackend:
         }
 
     def barrier(self) -> None:
+        # Blocks DISPATCHED work only (and settles its overflow flags).
+        # Deliberately does NOT flush an open train: at pipeline depth 1
+        # the batcher barriers every tick, and flushing here would pin
+        # the train length to 1.  Reads of device state go through
+        # ``lane_doc``/``flush_train``, which do flush.
+        self._drain_train_flags(block=True)
         np.asarray(self.docs.n)
 
     def sync_token(self):
@@ -276,6 +535,7 @@ class FlatLaneBackend:
         return self.docs.n
 
     def lane_doc(self, b: int):
+        self.flush_train()
         return jax.tree.map(lambda x: x[b], self.docs)
 
     def lane_signed(self, b: int) -> np.ndarray:
@@ -382,7 +642,8 @@ class ContinuousBatcher:
                  fuse_steps: bool = False, fuse_w: int = 1,
                  tracer=None, recorder=None, flow=None,
                  pipeline_ticks: int = 1,
-                 sanitize_pipeline: bool = False):
+                 sanitize_pipeline: bool = False,
+                 train_ticks: int = 1):
         assert tuple(sorted(step_buckets)) == tuple(step_buckets)
         self.router = router
         self.residency = residency
@@ -426,6 +687,18 @@ class ContinuousBatcher:
         # only — it emits no trace events, so sanitized runs stay
         # byte-identical on the logical stream.
         self.sanitize_pipeline = sanitize_pipeline
+        # Tick trains (ISSUE 20): with T > 1, backends that opt in
+        # (``max_train_ticks`` > 1 — the flat backend's device-prefill
+        # path) buffer T ticks' op tensors + prefill deltas and replay
+        # them as ONE device ``lax.scan`` program, collapsing T
+        # dispatch overheads into one.  Like pipeline depth, a pure
+        # wall-clock knob: trace events, counters and the journal all
+        # land at their per-tick logical positions, so logical streams
+        # are byte-identical at any train length.
+        self.train_ticks = max(1, train_ticks)
+        for b in residency.backends:
+            if hasattr(b, "set_train_ticks"):
+                b.set_train_ticks(self.train_ticks)
         self._inflight: List[dict] = []
         # Per-shard stall/win not yet attributed to a trace event: a
         # deferred entry's sync may pay stall for a shard that has no
@@ -473,6 +746,14 @@ class ContinuousBatcher:
         practice this is all-or-nothing)."""
         return min([self.pipeline_ticks]
                    + [getattr(b, "max_pipeline_ticks", 1)
+                      for b in self.residency.backends])
+
+    def effective_train_ticks(self) -> int:
+        """Configured train length capped by every backend's opt-in
+        (``max_train_ticks``; 1 on backends without a deferrable
+        dispatch path — host-prefill flat, the blocked lanes backend)."""
+        return min([self.train_ticks]
+                   + [getattr(b, "train_ticks", 1)
                       for b in self.residency.backends])
 
     def _sync_entry(self, entry: dict) -> None:
@@ -565,7 +846,12 @@ class ContinuousBatcher:
         latency percentiles).  Emits no trace events, so a flushed
         pipelined stream stays byte-identical to the serial one;
         idempotent and a no-op in the serial loop (depth 1 never leaves
-        an entry behind)."""
+        an entry behind).  Open tick trains dispatch first: their device
+        work must be enqueued before the entries' sync tokens can cover
+        it."""
+        for b in self.residency.backends:
+            if hasattr(b, "flush_train"):
+                b.flush_train()
         while self._inflight:
             self._sync_entry(self._inflight.pop(0))
 

@@ -162,7 +162,9 @@ def run_crash_scenario(phase: str, crash_tick: int, *,
                        drop_record_kind: Optional[int] = None,
                        workdir: Optional[str] = None,
                        run_twin: bool = True,
-                       twin_digest: Optional[str] = None
+                       twin_digest: Optional[str] = None,
+                       train_ticks: int = 1,
+                       recover_train_ticks: Optional[int] = None
                        ) -> Dict[str, object]:
     """One kill-and-recover cycle at ``phase`` during loadgen tick
     ``crash_tick`` (0-based), resumed to ``ticks``, checked against an
@@ -177,9 +179,14 @@ def run_crash_scenario(phase: str, crash_tick: int, *,
         workdir = tempfile.mkdtemp(prefix="tcr-chaos-")
     dirs = {name: os.path.join(workdir, name)
             for name in ("journal", "spool", "twin-journal", "twin-spool")}
+    # Tick trains (ISSUE 20): the victim and twin run at ``train_
+    # ticks``; recovery replays the journal at ``recover_train_ticks``
+    # (default: same) — the journal's per-tick markers make train
+    # length a pure wall-clock knob, so a journal written at one length
+    # must recover sha-identical at ANY other.
     base_cfg = dict(num_shards=num_shards, lanes_per_shard=lanes_per_shard,
                     ckpt_format=ckpt_format, journal_fsync_ticks=fsync_ticks,
-                    flow_sample_mod=1)
+                    flow_sample_mod=1, train_ticks=train_ticks)
     gen_kwargs = dict(docs=docs, agents_per_doc=agents_per_doc, ticks=ticks,
                       events_per_tick=events_per_tick, seed=seed,
                       fault_rate=fault_rate, byzantine=byzantine,
@@ -233,8 +240,11 @@ def run_crash_scenario(phase: str, crash_tick: int, *,
                                               kind=drop_record_kind)
 
         # -- recovery ----------------------------------------------------
+        cfg2_kw = dict(base_cfg)
+        if recover_train_ticks is not None:
+            cfg2_kw["train_ticks"] = recover_train_ticks
         cfg2 = ServeConfig(journal_dir=dirs["journal"],
-                           spool_dir=dirs["spool"], **base_cfg)
+                           spool_dir=dirs["spool"], **cfg2_kw)
         server2 = DocServer(cfg2)
         t0 = time.perf_counter()
         rstats = server2.recover()

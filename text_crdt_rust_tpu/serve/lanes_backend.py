@@ -92,6 +92,12 @@ class LanesMixedLaneBackend:
     # Depth 2 is what the dispatch-edge sync guarantees cheap true-up
     # reads for; deeper pipelines would partially serialize there.
     max_pipeline_ticks = 2
+    # Tick trains (ISSUE 20) stay off: this backend host-prefills rank
+    # state per tick and trues up run-row bounds at the dispatch edge,
+    # both incompatible with deferring ticks into a device-side train.
+    # The batcher's ``effective_train_ticks`` clamp reads this.
+    max_train_ticks = 1
+    train_ticks = 1
 
     def __init__(self, lanes: int, capacity: int, order_capacity: int,
                  lmax: int, block_k: int = 64,

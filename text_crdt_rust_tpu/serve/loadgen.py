@@ -648,6 +648,20 @@ class ServeLoadGen:
                 "scatter_compiles": tick_sum.get(
                     "prefill_scatter_compiles", 0),
             },
+            # Tick trains (ISSUE 20): the device-dispatch economy — how
+            # many device programs the run issued vs what the serial
+            # per-tick loop would have, the realized mean train length,
+            # and the (T, S) train-program compile count.
+            "train": {
+                "ticks": tick_sum.get("train_ticks", 1),
+                "device_dispatches": tick_sum.get(
+                    "device_dispatches", 0),
+                "dispatches_per_tick": tick_sum.get(
+                    "device_dispatches_per_tick", 0.0),
+                "dispatch_cut_x": tick_sum.get("dispatch_cut_x", 1.0),
+                "train_len": tick_sum.get("train_len", 1.0),
+                "train_compiles": tick_sum.get("train_compiles", 0),
+            },
             "wire": {
                 "format": self.wire,
                 "workload": self.workload,
@@ -795,6 +809,14 @@ def main(argv=None) -> None:
                          "work while the device step is in flight), "
                          "1 = the serial loop; logical streams are "
                          "byte-identical at any depth")
+    ap.add_argument("--train-ticks", type=int, default=d.train_ticks,
+                    help="device tick-train length: T > 1 buffers T "
+                         "ticks' op tensors + prefill scatters and "
+                         "replays them as ONE jitted lax.scan program "
+                         "(flat engine, device prefill only; lengths "
+                         "pad to powers of two so steady state never "
+                         "recompiles); logical streams are "
+                         "byte-identical at any length")
     ap.add_argument("--host-prefill", action="store_true",
                     help="disable device-resident prefill: round-trip "
                          "the full by-order logs through host numpy "
@@ -890,7 +912,7 @@ def main(argv=None) -> None:
             seed=a.seed, fault_rate=a.fault_rate, num_shards=a.shards,
             lanes_per_shard=a.lanes, ckpt_format=a.ckpt,
             fsync_ticks=a.journal_fsync_ticks, byzantine=a.byzantine,
-            flash_crowd=flash_crowd)
+            flash_crowd=flash_crowd, train_ticks=a.train_ticks)
         import json
 
         cell.pop("report")
@@ -904,6 +926,7 @@ def main(argv=None) -> None:
                       lanes_per_shard=a.lanes,
                       wire_format=a.wire, ckpt_format=a.ckpt,
                       pipeline_ticks=a.pipeline_ticks,
+                      train_ticks=a.train_ticks,
                       device_prefill=not a.host_prefill,
                       sanitize_pipeline=a.sanitize_pipeline,
                       nagle_txns=a.nagle_txns,

@@ -118,7 +118,8 @@ class DocServer:
                                          recorder=self.recorder,
                                          flow=self.flow,
                                          pipeline_ticks=cfg.pipeline_ticks,
-                                         sanitize_pipeline=cfg.sanitize_pipeline)
+                                         sanitize_pipeline=cfg.sanitize_pipeline,
+                                         train_ticks=cfg.train_ticks)
         self.tick_no = 0
         self._profiling = False
 
@@ -507,6 +508,32 @@ class DocServer:
                 p["prefill_scatter_len"] for p in pf)
             out["prefill_scatter_compiles"] = sum(
                 p["prefill_scatter_compiles"] for p in pf)
+        # Tick trains (ISSUE 20): the per-tick device-dispatch economy.
+        # ``device_dispatches`` counts actual device programs issued
+        # (train scans + prefill scatters); ``dispatch_serial_equiv`` is
+        # what the serial loop would have issued for the same stream,
+        # so ``dispatch_cut_x`` ~= train length x (scatter and scan both
+        # amortize).  ``train_len`` is the realized mean (flushes make
+        # partial trains); ``train_compiles`` counts distinct (T, S)
+        # train programs — report-only, never traced, so the logical
+        # stream stays train-length-invariant.
+        tn = [b.train_summary() for b in self.residency.backends
+              if hasattr(b, "train_summary")]
+        if tn:
+            out["train_ticks"] = self.batcher.effective_train_ticks()
+            out["device_dispatches"] = sum(
+                t["device_dispatches"] for t in tn)
+            out["device_dispatches_per_tick"] = round(
+                out["device_dispatches"]
+                / max(c.get("device_ticks", 0), 1), 3)
+            out["dispatch_serial_equiv"] = sum(
+                t["dispatch_serial_equiv"] for t in tn)
+            out["dispatch_cut_x"] = round(
+                out["dispatch_serial_equiv"]
+                / max(out["device_dispatches"], 1), 2)
+            out["train_len"] = round(sum(
+                t["train_len"] for t in tn) / len(tn), 2)
+            out["train_compiles"] = sum(t["train_compiles"] for t in tn)
         # Flight-recorder visibility (ISSUE 10 satellite): how many
         # post-mortem bundles this run wrote and how many same-reason
         # repeats were suppressed — a nonzero suppressed count in a
